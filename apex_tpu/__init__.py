@@ -1,0 +1,84 @@
+"""apex_tpu — a TPU-native training-acceleration framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of Apex
+(mixed-precision training, fused kernels and optimizers, data/tensor/pipeline
+parallelism) for TPU hardware.  Nothing here is a port: the reference's
+CUDA streams, monkey-patching and NCCL process groups are replaced by their
+idiomatic TPU equivalents — precision *policies* applied at function
+boundaries, jit-fused pytree optimizers, Pallas kernels for the hot ops, and
+`jax.sharding.Mesh` axes with XLA collectives for every flavour of
+parallelism.
+
+Layout (mirrors the reference's component inventory, see SURVEY.md §2):
+
+- :mod:`apex_tpu.amp`            — precision policies O0–O5, dynamic loss scaling
+- :mod:`apex_tpu.optimizers`     — fused Adam/LAMB/SGD/NovoGrad/Adagrad (+ mixed-precision LAMB)
+- :mod:`apex_tpu.multi_tensor_apply` — whole-pytree scale/axpby/l2norm primitives
+- :mod:`apex_tpu.normalization`  — fused LayerNorm (Pallas)
+- :mod:`apex_tpu.fused_dense`    — GEMM+bias(+GELU) fused layers
+- :mod:`apex_tpu.mlp`            — whole-MLP fused module
+- :mod:`apex_tpu.ops`            — Pallas kernels (layernorm, softmax, flash attention, …)
+- :mod:`apex_tpu.parallel`       — data-parallel runtime, SyncBatchNorm, LARC
+- :mod:`apex_tpu.transformer`    — Megatron-style tensor/pipeline parallel toolkit
+- :mod:`apex_tpu.contrib`        — xentropy, ASP sparsity, MHA modules, …
+"""
+
+__version__ = "0.1.0"
+
+import logging as _logging
+import os as _os
+
+
+class RankInfoFormatter(_logging.Formatter):
+    """Rank-annotated log formatter.
+
+    TPU-native analog of the reference's rank-aware root logger
+    (reference: apex/__init__.py:30-42) — uses the JAX process index
+    instead of torch.distributed rank.
+    """
+
+    def format(self, record):
+        try:
+            import jax
+
+            rank = jax.process_index()
+            world = jax.process_count()
+        except Exception:
+            rank, world = 0, 1
+        record.rank_info = f"[{rank}/{world}]"
+        return super().format(record)
+
+
+def _install_logger():
+    logger = _logging.getLogger("apex_tpu")
+    if not logger.handlers:
+        handler = _logging.StreamHandler()
+        handler.setFormatter(
+            RankInfoFormatter(
+                "%(asctime)s %(rank_info)s %(name)s %(levelname)s: %(message)s"
+            )
+        )
+        logger.addHandler(handler)
+        logger.setLevel(
+            _os.environ.get("APEX_TPU_LOG_LEVEL", "WARNING").upper()
+        )
+    return logger
+
+
+logger = _install_logger()
+
+from apex_tpu import amp  # noqa: E402
+from apex_tpu import multi_tensor_apply  # noqa: E402
+from apex_tpu import optimizers  # noqa: E402
+from apex_tpu import normalization  # noqa: E402
+from apex_tpu import parallel  # noqa: E402
+
+__all__ = [
+    "amp",
+    "multi_tensor_apply",
+    "optimizers",
+    "normalization",
+    "parallel",
+    "logger",
+    "__version__",
+]

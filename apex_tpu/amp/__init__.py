@@ -1,0 +1,153 @@
+"""apex_tpu.amp — mixed-precision API (opt levels O0–O5).
+
+Functional, jit-native replacement for the reference amp package
+(reference: apex/amp/).  The moving parts:
+
+- :class:`Policy` / :func:`get_policy` — the opt-level presets
+- :class:`LossScaler` / :class:`ScalerState` — pure-state loss scaling
+- :class:`MixedPrecision` — bundles a policy with per-loss scalers and
+  offers the ``initialize``-shaped entry point
+
+Typical use (the analog of the reference README recipe,
+reference: README.md:60-100):
+
+    mp = amp.initialize(opt_level="O2", num_losses=1)
+    params, amp_state = mp.init(params)          # casts params per policy
+    ...inside the jitted train step:
+        scaled = mp.scale_loss(amp_state, loss)
+        grads, finite, amp_state = mp.unscale_and_adjust(amp_state, grads)
+        new_params = optimizer step...
+        params = mp.apply_if_finite(finite, params, new_params)
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import (  # noqa: F401
+    OPT_LEVELS,
+    Policy,
+    get_policy,
+    is_norm_param,
+    tree_cast,
+)
+from apex_tpu.amp.scaler import (  # noqa: F401
+    LossScaler,
+    ScalerState,
+    all_finite,
+    scale_gradients,
+)
+
+__all__ = [
+    "Policy",
+    "get_policy",
+    "OPT_LEVELS",
+    "LossScaler",
+    "ScalerState",
+    "all_finite",
+    "MixedPrecision",
+    "AmpState",
+    "initialize",
+    "tree_cast",
+    "is_norm_param",
+]
+
+
+class AmpState(NamedTuple):
+    """Device-side amp state: one ScalerState per loss
+    (reference's per-loss ``_amp_state.loss_scalers`` list,
+    reference: apex/amp/_amp_state.py, apex/amp/handle.py:16-158)."""
+
+    scaler_states: Tuple[ScalerState, ...]
+
+
+class MixedPrecision:
+    """Static configuration object pairing a :class:`Policy` with
+    per-loss :class:`LossScaler` machinery."""
+
+    def __init__(self, policy: Policy, num_losses: int = 1, **scaler_kwargs):
+        self.policy = policy
+        self.num_losses = num_losses
+        self.scaler = LossScaler(loss_scale=policy.loss_scale, **scaler_kwargs)
+
+    # -- lifecycle -------------------------------------------------------
+    def init(self, params: Any = None):
+        """Cast ``params`` per the policy and build fresh scaler states.
+
+        Returns ``(cast_params, AmpState)``; with ``params=None`` returns
+        just the AmpState.
+        """
+        state = AmpState(
+            scaler_states=tuple(self.scaler.init() for _ in range(self.num_losses))
+        )
+        if params is None:
+            return state
+        return self.policy.cast_to_param(params), state
+
+    # -- loss scaling ----------------------------------------------------
+    def scale_loss(self, state: AmpState, loss: jnp.ndarray, loss_id: int = 0):
+        return self.scaler.scale(state.scaler_states[loss_id], loss)
+
+    def unscale_and_adjust(
+        self, state: AmpState, grads: Any, loss_id: int = 0
+    ) -> Tuple[Any, jnp.ndarray, AmpState]:
+        grads, finite, new_sstate = self.scaler.unscale_and_adjust(
+            state.scaler_states[loss_id], grads
+        )
+        states = list(state.scaler_states)
+        states[loss_id] = new_sstate
+        return grads, finite, AmpState(scaler_states=tuple(states))
+
+    @staticmethod
+    def apply_if_finite(finite: jnp.ndarray, old_tree: Any, new_tree: Any) -> Any:
+        """Skip-step on overflow: keep ``old_tree`` when not finite
+        (reference's patched skip-step, apex/amp/handle.py:128-154)."""
+        return jax.tree.map(lambda o, n: jnp.where(finite, n, o), old_tree, new_tree)
+
+    # -- master weights --------------------------------------------------
+    def make_master(self, params: Any) -> Any:
+        """fp32 master copy for O2/O5
+        (reference: apex/amp/_process_optimizer.py:28-91)."""
+        return self.policy.cast_to_master(params)
+
+    def master_to_model(self, master: Any) -> Any:
+        """Cast masters back to model precision for the forward pass
+        (reference: apex/amp/_process_optimizer.py:14)."""
+        return self.policy.cast_to_param(master)
+
+    # -- checkpointing ---------------------------------------------------
+    def state_dict(self, state: AmpState) -> dict:
+        """Serializable amp state (reference: apex/amp/frontend.py:428-467)."""
+        return {
+            f"loss_scaler{i}": self.scaler.state_dict(s)
+            for i, s in enumerate(state.scaler_states)
+        }
+
+    def load_state_dict(self, d: dict) -> AmpState:
+        states = []
+        for i in range(self.num_losses):
+            states.append(self.scaler.load_state_dict(d[f"loss_scaler{i}"]))
+        return AmpState(scaler_states=tuple(states))
+
+
+def initialize(
+    opt_level: str = "O5", num_losses: int = 1, **overrides
+) -> MixedPrecision:
+    """Build a :class:`MixedPrecision` from an opt level + overrides —
+    the shape of ``apex.amp.initialize``
+    (reference: apex/amp/frontend.py:258-425) minus the in-place model
+    surgery JAX neither needs nor allows."""
+    scaler_keys = {
+        "init_scale",
+        "growth_factor",
+        "backoff_factor",
+        "growth_interval",
+        "max_loss_scale",
+        "min_loss_scale",
+    }
+    scaler_kwargs = {k: overrides.pop(k) for k in list(overrides) if k in scaler_keys}
+    policy = get_policy(opt_level, **overrides)
+    return MixedPrecision(policy, num_losses=num_losses, **scaler_kwargs)

@@ -1,0 +1,260 @@
+"""Precision policies — the TPU-native replacement for amp opt levels.
+
+The reference implements mixed precision by monkey-patching the torch
+namespace per whitelist/blacklist and casting models in place
+(reference: apex/amp/frontend.py:118-254 for the O0–O5 presets,
+apex/amp/amp.py:75-198 for the patcher).  Monkey-patching has no JAX
+equivalent — and doesn't need one: under `jit` every cast is explicit and
+free to fuse.  So the opt levels become a frozen :class:`Policy` value that
+modules and training steps consult at function boundaries:
+
+- ``param_dtype``   — dtype in which parameters are *stored*
+- ``compute_dtype`` — dtype in which matmul/conv compute runs
+- ``output_dtype``  — dtype of function outputs (None = compute_dtype)
+- ``keep_norm_fp32``— norm/bn parameters and statistics stay fp32
+                      (reference ``keep_batchnorm_fp32``)
+- ``master_weights``— optimizer keeps an fp32 master copy of low-precision
+                      params (reference O2/O5 master-weight path,
+                      apex/amp/_process_optimizer.py:28-91)
+- ``loss_scale``    — float for static scaling, "dynamic", or None
+
+The preset names O0..O5 match the reference one-to-one (O4/O5 are the bf16
+levels this fork added — the natural TPU defaults).  Like the reference's
+`amp.initialize(..., **overrides)` (apex/amp/frontend.py:258-425), any
+explicit keyword beats the preset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Policy",
+    "get_policy",
+    "OPT_LEVELS",
+    "tree_cast",
+    "is_norm_param",
+]
+
+_NORM_KEY_FRAGMENTS = (
+    "batchnorm",
+    "bn",
+    "layernorm",
+    "layer_norm",
+    "ln",
+    "norm",
+    "groupnorm",
+    "rmsnorm",
+    "scale",  # flax convention for LN scale
+)
+
+
+def is_norm_param(path: tuple, _leaf=None) -> bool:
+    """Heuristic used by ``keep_norm_fp32``: does a pytree path name a
+    normalization parameter?  Matches on common key fragments the way the
+    reference's ``convert_network`` matches module classes
+    (reference: apex/fp16_utils/fp16util.py:60-87)."""
+    for entry in path:
+        name = getattr(entry, "key", None) or getattr(entry, "name", None)
+        if name is None:
+            continue
+        lowered = str(name).lower()
+        for frag in _NORM_KEY_FRAGMENTS:
+            if frag in lowered:
+                return True
+    return False
+
+
+def _cast_leaf(leaf: Any, dtype: Optional[jnp.dtype]) -> Any:
+    if dtype is None:
+        return leaf
+    if isinstance(leaf, (jax.Array, jnp.ndarray)) or hasattr(leaf, "dtype"):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            return jnp.asarray(leaf, dtype=dtype)
+    return leaf
+
+
+def tree_cast(
+    tree: Any,
+    dtype: Optional[jnp.dtype],
+    *,
+    keep_fp32_predicate: Optional[Callable[[tuple], bool]] = None,
+) -> Any:
+    """Cast all floating leaves of ``tree`` to ``dtype``; leaves whose path
+    satisfies ``keep_fp32_predicate`` stay float32."""
+    if dtype is None:
+        return tree
+    if keep_fp32_predicate is None:
+        return jax.tree.map(lambda l: _cast_leaf(l, dtype), tree)
+
+    def cast_with_path(path, leaf):
+        if keep_fp32_predicate(path):
+            return _cast_leaf(leaf, jnp.float32)
+        return _cast_leaf(leaf, dtype)
+
+    return jax.tree_util.tree_map_with_path(cast_with_path, tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """A frozen precision policy.  See module docstring.
+
+    ``loss_scale`` follows the reference semantics
+    (apex/amp/frontend.py:158-254): "dynamic" for O1/O2, 1.0 for
+    O0/O3, None (no scaling machinery at all) for the bf16 levels O4/O5.
+    """
+
+    opt_level: str = "O5"
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: Optional[jnp.dtype] = None
+    keep_norm_fp32: bool = True
+    master_weights: bool = False
+    loss_scale: Optional[Union[float, str]] = None
+
+    # -- casting helpers -------------------------------------------------
+    def cast_to_param(self, tree: Any) -> Any:
+        pred = is_norm_param if self.keep_norm_fp32 else None
+        return tree_cast(tree, self.param_dtype, keep_fp32_predicate=pred)
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        return tree_cast(tree, self.compute_dtype)
+
+    def cast_to_output(self, tree: Any) -> Any:
+        return tree_cast(tree, self.output_dtype or self.compute_dtype)
+
+    def cast_to_master(self, tree: Any) -> Any:
+        return tree_cast(tree, jnp.float32)
+
+    # -- properties ------------------------------------------------------
+    @property
+    def uses_loss_scaling(self) -> bool:
+        return self.loss_scale is not None
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == "dynamic"
+
+    @property
+    def low_precision(self) -> bool:
+        return self.param_dtype != jnp.float32 or self.compute_dtype != jnp.float32
+
+    def replace(self, **kw) -> "Policy":
+        return dataclasses.replace(self, **kw)
+
+    def describe(self) -> str:
+        lines = [f"apex_tpu.amp policy: {self.opt_level}"]
+        for f in dataclasses.fields(self):
+            lines.append(f"  {f.name:18s}: {getattr(self, f.name)}")
+        return "\n".join(lines)
+
+
+def _O0() -> Policy:
+    return Policy(
+        opt_level="O0",
+        param_dtype=jnp.float32,
+        compute_dtype=jnp.float32,
+        keep_norm_fp32=False,
+        master_weights=False,
+        loss_scale=1.0,
+    )
+
+
+def _O1() -> Policy:
+    # fp32 params, fp16 compute at whitelisted boundaries, dynamic scaling
+    # (reference: apex/amp/frontend.py:139-160).
+    return Policy(
+        opt_level="O1",
+        param_dtype=jnp.float32,
+        compute_dtype=jnp.float16,
+        output_dtype=jnp.float32,
+        keep_norm_fp32=True,
+        master_weights=False,
+        loss_scale="dynamic",
+    )
+
+
+def _O2() -> Policy:
+    # fp16 params (norms fp32), fp32 masters, dynamic scaling
+    # (reference: apex/amp/frontend.py:161-183).
+    return Policy(
+        opt_level="O2",
+        param_dtype=jnp.float16,
+        compute_dtype=jnp.float16,
+        keep_norm_fp32=True,
+        master_weights=True,
+        loss_scale="dynamic",
+    )
+
+
+def _O3() -> Policy:
+    # pure fp16 "speed-of-light" mode (reference: apex/amp/frontend.py:118-138).
+    return Policy(
+        opt_level="O3",
+        param_dtype=jnp.float16,
+        compute_dtype=jnp.float16,
+        keep_norm_fp32=False,
+        master_weights=False,
+        loss_scale=1.0,
+    )
+
+
+def _O4() -> Policy:
+    # bf16 compute, fp32 params, NO loss scaling — bf16's range makes the
+    # scaler unnecessary (reference: apex/amp/frontend.py:207-225).
+    return Policy(
+        opt_level="O4",
+        param_dtype=jnp.float32,
+        compute_dtype=jnp.bfloat16,
+        output_dtype=jnp.float32,
+        keep_norm_fp32=True,
+        master_weights=False,
+        loss_scale=None,
+    )
+
+
+def _O5() -> Policy:
+    # bf16 params + fp32 masters, no loss scaling
+    # (reference: apex/amp/frontend.py:226-254).  The TPU default.
+    return Policy(
+        opt_level="O5",
+        param_dtype=jnp.bfloat16,
+        compute_dtype=jnp.bfloat16,
+        keep_norm_fp32=True,
+        master_weights=True,
+        loss_scale=None,
+    )
+
+
+OPT_LEVELS = {
+    "O0": _O0,
+    "O1": _O1,
+    "O2": _O2,
+    "O3": _O3,
+    "O4": _O4,
+    "O5": _O5,
+}
+
+
+def get_policy(opt_level: str = "O5", **overrides) -> Policy:
+    """Build a :class:`Policy` from a preset plus explicit overrides.
+
+    Mirrors ``amp.initialize``'s preset-with-override behaviour
+    (reference: apex/amp/frontend.py:373-419): any override whose value is
+    not None replaces the preset field.
+    """
+    if opt_level not in OPT_LEVELS:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level!r}. "
+            "Options are 'O0', 'O1', 'O2', 'O3', 'O4', 'O5'. Note that in "
+            "'O0', 'O1', etc., the prefix O is the letter O, not the number zero."
+        )
+    policy = OPT_LEVELS[opt_level]()
+    clean = {k: v for k, v in overrides.items() if v is not None}
+    if clean:
+        policy = dataclasses.replace(policy, **clean)
+    return policy

@@ -1,0 +1,176 @@
+"""Functional loss scaling — jit-native replacement for the amp LossScaler.
+
+The reference scaler (reference: apex/amp/scaler.py:42-226) mutates a
+python object, launches fused unscale kernels with an overflow "noop"
+buffer, and does one device-to-host sync per step in ``update_scale``.
+On TPU all of that collapses into a pure state value threaded through the
+jitted train step:
+
+    scaler = LossScaler()                       # config (static)
+    state  = scaler.init()                      # ScalerState (device value)
+    scaled_loss = scaler.scale(state, loss)
+    grads, finite = scaler.unscale(state, grads)
+    state = scaler.adjust(state, finite)        # growth/backoff, lax.cond
+    params = jax.tree.map(lambda p, n: jnp.where(finite, n, p), params, new_params)
+
+No host sync happens at all unless the user asks for the current scale.
+The growth/backoff schedule matches the reference exactly: init 2**16,
+double every 2000 clean steps, halve on overflow, clamp to [min, 2**24]
+(reference: apex/amp/scaler.py:52-64, 206-226).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "ScalerState",
+    "LossScaler",
+    "all_finite",
+    "scale_gradients",
+]
+
+
+class ScalerState(NamedTuple):
+    """Checkpointable scaler state (analog of the reference's
+    ``state_dict`` contents: loss_scale + unskipped counter,
+    reference: apex/amp/frontend.py:428-467)."""
+
+    loss_scale: jnp.ndarray  # f32 scalar
+    growth_tracker: jnp.ndarray  # i32 scalar — clean steps since last growth
+    unskipped: jnp.ndarray  # i32 scalar — total non-overflow steps
+
+
+def all_finite(tree: Any) -> jnp.ndarray:
+    """True iff every element of every floating leaf is finite.
+
+    The functional analog of the reference's overflow "noop buffer" that
+    every multi-tensor kernel writes into
+    (reference: csrc/multi_tensor_apply.cuh:16-147).
+    """
+    leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "dtype")]
+    leaves = [l for l in leaves if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.bool_(True)
+    finites = [jnp.all(jnp.isfinite(l)) for l in leaves]
+    return jnp.stack(finites).all()
+
+
+def scale_gradients(tree: Any, scale: Union[float, jnp.ndarray]) -> Any:
+    return jax.tree.map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype)
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)
+        else g,
+        tree,
+    )
+
+
+class LossScaler:
+    """Static or dynamic loss scaler as a pure-state machine.
+
+    ``loss_scale="dynamic"`` reproduces the reference's dynamic scaler;
+    a float gives static scaling (growth disabled); ``None`` or 1.0 is a
+    no-op pass-through (the bf16 O4/O5 path).
+    """
+
+    def __init__(
+        self,
+        loss_scale: Optional[Union[float, str]] = "dynamic",
+        init_scale: float = 2.0 ** 16,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        growth_interval: int = 2000,
+        max_loss_scale: float = 2.0 ** 24,
+        min_loss_scale: Optional[float] = None,
+    ):
+        self.dynamic = loss_scale == "dynamic"
+        if loss_scale is None:
+            self._static_scale = 1.0
+        elif self.dynamic:
+            self._static_scale = init_scale
+        else:
+            self._static_scale = float(loss_scale)
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+        self.max_loss_scale = max_loss_scale
+        self.min_loss_scale = min_loss_scale if min_loss_scale is not None else 1.0
+
+    # -- state -----------------------------------------------------------
+    def init(self) -> ScalerState:
+        return ScalerState(
+            loss_scale=jnp.float32(self._static_scale),
+            growth_tracker=jnp.int32(0),
+            unskipped=jnp.int32(0),
+        )
+
+    # -- core ops (all jit-safe) ----------------------------------------
+    def scale(self, state: ScalerState, loss: jnp.ndarray) -> jnp.ndarray:
+        """``loss.float() * loss_scale`` (reference: apex/amp/handle.py:113)."""
+        return loss.astype(jnp.float32) * state.loss_scale
+
+    def unscale(self, state: ScalerState, grads: Any) -> Tuple[Any, jnp.ndarray]:
+        """Unscale grads by 1/scale; also report whether they are all finite.
+
+        Non-finite grads are passed through (the caller skips the step via
+        ``jnp.where(finite, ...)``), matching the reference's skip-step
+        patch (reference: apex/amp/handle.py:128-154).
+        """
+        finite = all_finite(grads)
+        inv = 1.0 / state.loss_scale
+        grads = scale_gradients(grads, inv)
+        return grads, finite
+
+    def adjust(self, state: ScalerState, grads_finite: jnp.ndarray) -> ScalerState:
+        """Dynamic growth/backoff (reference: apex/amp/scaler.py:206-226)."""
+        if not self.dynamic:
+            return ScalerState(
+                loss_scale=state.loss_scale,
+                growth_tracker=state.growth_tracker,
+                unskipped=state.unskipped + grads_finite.astype(jnp.int32),
+            )
+        tracker = jnp.where(grads_finite, state.growth_tracker + 1, 0)
+        grown = tracker >= self.growth_interval
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(
+                grown,
+                jnp.minimum(state.loss_scale * self.growth_factor, self.max_loss_scale),
+                state.loss_scale,
+            ),
+            jnp.maximum(state.loss_scale * self.backoff_factor, self.min_loss_scale),
+        )
+        tracker = jnp.where(grown, 0, tracker)
+        return ScalerState(
+            loss_scale=new_scale.astype(jnp.float32),
+            growth_tracker=tracker.astype(jnp.int32),
+            unskipped=state.unskipped + grads_finite.astype(jnp.int32),
+        )
+
+    # -- one-shot convenience -------------------------------------------
+    def unscale_and_adjust(
+        self, state: ScalerState, grads: Any
+    ) -> Tuple[Any, jnp.ndarray, ScalerState]:
+        grads, finite = self.unscale(state, grads)
+        return grads, finite, self.adjust(state, finite)
+
+    # -- checkpointing ---------------------------------------------------
+    def state_dict(self, state: ScalerState) -> dict:
+        """Host-side checkpointable dict (one D2H here, and only here —
+        analog of the reference's single deferred sync,
+        reference: apex/amp/scaler.py:206-209)."""
+        return {
+            "loss_scale": float(state.loss_scale),
+            "growth_tracker": int(state.growth_tracker),
+            "unskipped": int(state.unskipped),
+        }
+
+    def load_state_dict(self, d: dict) -> ScalerState:
+        return ScalerState(
+            loss_scale=jnp.float32(d["loss_scale"]),
+            growth_tracker=jnp.int32(d["growth_tracker"]),
+            unskipped=jnp.int32(d["unskipped"]),
+        )

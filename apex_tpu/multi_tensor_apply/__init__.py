@@ -1,0 +1,128 @@
+"""Whole-pytree tensor-list primitives.
+
+The reference's ``multi_tensor_apply`` engine exists to amortize kernel
+launches: one CUDA launch processes chunks of up to 110 tensors, with a
+``noop_flag`` overflow buffer for sync-free loss scaling
+(reference: csrc/multi_tensor_apply.cuh:16-147,
+apex/multi_tensor_apply/multi_tensor_apply.py:3-30).
+
+Under XLA there is no per-tensor launch cost to amortize — a jitted
+function over a whole pytree compiles to a handful of fused loops.  So the
+TPU-native "multi tensor apply" is simply: express the op over the pytree,
+jit it once.  These functions keep the reference's *semantics* (including
+the overflow flag) with none of its machinery, and are the building blocks
+the fused optimizers and the scaler share.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "multi_tensor_scale",
+    "multi_tensor_axpby",
+    "multi_tensor_l2norm",
+    "global_l2norm",
+    "multi_tensor_applier",
+]
+
+
+def _float_leaves(tree):
+    return [
+        l
+        for l in jax.tree.leaves(tree)
+        if hasattr(l, "dtype") and jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+    ]
+
+
+def multi_tensor_scale(
+    tree: Any, scale: Union[float, jnp.ndarray], out_dtype: Optional[jnp.dtype] = None
+) -> Tuple[Any, jnp.ndarray]:
+    """``out = in * scale`` over every leaf, plus an all-finite flag.
+
+    Equivalent of ``amp_C.multi_tensor_scale``
+    (reference: csrc/multi_tensor_scale_kernel.cu).  Returns
+    ``(scaled_tree, overflow)`` where overflow is True if any *input* leaf
+    contained inf/nan (the kernel's noop_flag contract: it checks the
+    incoming values it reads).
+    """
+
+    def scale_leaf(l):
+        if not jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating):
+            return l
+        out = l.astype(jnp.float32) * scale
+        return out.astype(out_dtype or l.dtype)
+
+    scaled = jax.tree.map(scale_leaf, tree)
+    leaves = _float_leaves(tree)
+    if leaves:
+        overflow = ~jnp.stack([jnp.all(jnp.isfinite(l)) for l in leaves]).all()
+    else:
+        overflow = jnp.bool_(False)
+    return scaled, overflow
+
+
+def multi_tensor_axpby(
+    a: Union[float, jnp.ndarray],
+    x_tree: Any,
+    b: Union[float, jnp.ndarray],
+    y_tree: Any,
+    out_dtype: Optional[jnp.dtype] = None,
+) -> Tuple[Any, jnp.ndarray]:
+    """``out = a*x + b*y`` leafwise with an overflow flag
+    (reference: csrc/multi_tensor_axpby_kernel.cu) — the kernel behind
+    stashed-gradient accumulation in amp
+    (reference: apex/amp/_process_optimizer.py:93-139)."""
+
+    def axpby(x, y):
+        out = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        return out.astype(out_dtype or x.dtype)
+
+    out = jax.tree.map(axpby, x_tree, y_tree)
+    leaves = _float_leaves(x_tree) + _float_leaves(y_tree)
+    if leaves:
+        overflow = ~jnp.stack([jnp.all(jnp.isfinite(l)) for l in leaves]).all()
+    else:
+        overflow = jnp.bool_(False)
+    return out, overflow
+
+
+def multi_tensor_l2norm(
+    tree: Any, per_tensor: bool = False
+) -> Union[jnp.ndarray, Tuple[jnp.ndarray, list]]:
+    """Global (and optionally per-leaf) L2 norm in fp32 accumulation
+    (reference: csrc/multi_tensor_l2norm_kernel.cu), used by FusedLAMB's
+    global grad norm (reference: apex/optimizers/fused_lamb.py:107-137)."""
+    leaves = _float_leaves(tree)
+    if not leaves:
+        zero = jnp.float32(0.0)
+        return (zero, []) if per_tensor else zero
+    sq = [jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves]
+    total = jnp.sqrt(jnp.stack(sq).sum())
+    if per_tensor:
+        return total, [jnp.sqrt(s) for s in sq]
+    return total
+
+
+def global_l2norm(tree: Any) -> jnp.ndarray:
+    return multi_tensor_l2norm(tree, per_tensor=False)
+
+
+class multi_tensor_applier:
+    """API-compat shim for code written against the reference dispatcher
+    (reference: apex/multi_tensor_apply/multi_tensor_apply.py:3-30).
+
+    ``op`` is any callable taking/returning pytrees; chunking is
+    irrelevant under XLA so ``chunk_size`` is accepted and ignored.
+    """
+
+    available = True
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag_buffer, tensor_lists, *args):
+        return op(tensor_lists, *args)

@@ -1,0 +1,32 @@
+"""apex_tpu.normalization — fused LayerNorm family.
+
+TPU-native equivalent of the reference's fused layernorm extensions
+(reference: apex/normalization/fused_layer_norm.py:15-218,
+csrc/layer_norm_cuda_kernel.cu, apex/contrib/csrc/layer_norm/).  The
+functional forms dispatch to a Pallas kernel on TPU and a pure-XLA path
+elsewhere; both share one ``custom_vjp``.
+"""
+
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+    mixed_dtype_fused_layer_norm_affine,
+)
+from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401
+    FusedLayerNorm,
+    MixedFusedLayerNorm,
+    FusedRMSNorm,
+)
+
+__all__ = [
+    "fused_layer_norm",
+    "fused_layer_norm_affine",
+    "fused_rms_norm",
+    "fused_rms_norm_affine",
+    "mixed_dtype_fused_layer_norm_affine",
+    "FusedLayerNorm",
+    "MixedFusedLayerNorm",
+    "FusedRMSNorm",
+]

@@ -1,0 +1,99 @@
+"""Module-level LayerNorm APIs (flax.linen).
+
+Analogs of the reference modules (reference:
+apex/normalization/fused_layer_norm.py:15-218):
+
+- :class:`FusedLayerNorm` — ``elementwise_affine`` toggle, fp32 stats
+- :class:`MixedFusedLayerNorm` — output dtype follows param dtype
+  (Megatron-compatible)
+- :class:`FusedRMSNorm` — RMS variant
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import (
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+    mixed_dtype_fused_layer_norm_affine,
+)
+
+__all__ = ["FusedLayerNorm", "MixedFusedLayerNorm", "FusedRMSNorm"]
+
+
+def _shape_tuple(normalized_shape: Union[int, Sequence[int]]):
+    if isinstance(normalized_shape, int):
+        return (normalized_shape,)
+    return tuple(int(s) for s in normalized_shape)
+
+
+class FusedLayerNorm(nn.Module):
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+    implementation: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _shape_tuple(self.normalized_shape)
+        if not self.elementwise_affine:
+            return fused_layer_norm(x, shape, self.eps, self.implementation)
+        weight = self.param(
+            "weight", nn.initializers.ones, shape, self.param_dtype
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, shape, self.param_dtype
+        )
+        return fused_layer_norm_affine(
+            x, weight, bias, shape, self.eps, self.implementation
+        )
+
+
+class MixedFusedLayerNorm(nn.Module):
+    """Output dtype = param dtype even when the input differs
+    (reference: MixedFusedLayerNorm / forward_affine_mixed_dtypes)."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    param_dtype: jnp.dtype = jnp.float32
+    implementation: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _shape_tuple(self.normalized_shape)
+        weight = self.param(
+            "weight", nn.initializers.ones, shape, self.param_dtype
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, shape, self.param_dtype
+        )
+        return mixed_dtype_fused_layer_norm_affine(
+            x, weight, bias, shape, self.eps, self.implementation
+        )
+
+
+class FusedRMSNorm(nn.Module):
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+    implementation: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _shape_tuple(self.normalized_shape)
+        if not self.elementwise_affine:
+            return fused_rms_norm(x, shape, self.eps, self.implementation)
+        weight = self.param(
+            "weight", nn.initializers.ones, shape, self.param_dtype
+        )
+        return fused_rms_norm_affine(
+            x, weight, shape, self.eps, self.implementation
+        )

@@ -1,0 +1,24 @@
+"""apex_tpu.ops — the kernel layer.
+
+TPU-native replacement for the reference's ``csrc/`` CUDA kernel tier
+(SURVEY.md §2.2): every op is a jittable function with a Pallas TPU fast
+path and a pure-XLA fallback sharing one ``custom_vjp``, so numerics are
+identical across backends (the reference's L1 "ext vs python path"
+bitwise test philosophy, reference: tests/L1/common/run_test.sh:118-137).
+"""
+
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+    mixed_dtype_fused_layer_norm_affine,
+)
+
+__all__ = [
+    "fused_layer_norm",
+    "fused_layer_norm_affine",
+    "fused_rms_norm",
+    "fused_rms_norm_affine",
+    "mixed_dtype_fused_layer_norm_affine",
+]

@@ -1,0 +1,32 @@
+"""apex_tpu.optimizers — fused optimizers as pure jitted pytree transforms.
+
+TPU-native equivalents of the reference optimizer suite
+(reference: apex/optimizers/): one jitted update over the whole parameter
+pytree replaces the multi-tensor CUDA launch machinery.  All support
+fp32 master weights (``master_weights=True``) and overflow skip-steps
+(``grads_finite=...``).  ZeRO-style sharded variants live in
+:mod:`apex_tpu.optimizers.distributed`.
+"""
+
+from apex_tpu.optimizers.base import FusedOptimizer  # noqa: F401
+from apex_tpu.optimizers.fused_adam import FusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import FusedSGD  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import FusedLAMB  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import FusedAdagrad  # noqa: F401
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (  # noqa: F401
+    FusedMixedPrecisionLamb,
+)
+from apex_tpu.optimizers.larc import LARC, larc_transform  # noqa: F401
+
+__all__ = [
+    "FusedOptimizer",
+    "FusedAdam",
+    "FusedSGD",
+    "FusedLAMB",
+    "FusedNovoGrad",
+    "FusedAdagrad",
+    "FusedMixedPrecisionLamb",
+    "LARC",
+    "larc_transform",
+]

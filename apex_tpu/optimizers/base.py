@@ -1,0 +1,135 @@
+"""Shared machinery for the fused optimizers.
+
+The reference's "fused" optimizers exist to collapse hundreds of
+per-tensor CUDA launches into a handful of multi-tensor launches
+(reference: apex/optimizers/fused_adam.py:134-170).  Under XLA a jitted
+update over the whole param pytree already compiles to a few fused loops,
+so the TPU-native design point is different: each optimizer here is a pure
+``(state, grads, params) -> (params, state)`` function that
+
+- runs its math in fp32 regardless of storage dtype,
+- optionally owns an fp32 **master** copy of low-precision params
+  (the O2/O5 and multi_tensor_lamb_mp capability,
+  reference: apex/optimizers/fused_mixed_precision_lamb.py),
+- takes an optional ``grads_finite`` flag making the entire update
+  (moments, step count, params) a no-op on overflow — the functional form
+  of amp's skip-step (reference: apex/amp/handle.py:128-154).
+
+Every optimizer also exposes ``as_optax()`` returning a standard optax
+``GradientTransformation`` for drop-in use in optax pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["FusedOptimizer", "tree_where", "f32", "apply_updates"]
+
+
+def f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def tree_where(cond, a_tree, b_tree):
+    """Leafwise ``where(cond, a, b)`` — the skip-step combinator."""
+    return jax.tree.map(lambda a, b: jnp.where(cond, a, b), a_tree, b_tree)
+
+
+def apply_updates(params, updates):
+    return jax.tree.map(lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+                        params, updates)
+
+
+class FusedOptimizer:
+    """Base class: subclasses implement ``_init_extra`` and ``_update``.
+
+    ``master_weights=True`` keeps an fp32 master in the optimizer state;
+    ``step`` then updates the master and returns model-dtype params cast
+    from it, so the training loop never touches fp32 copies itself.
+    """
+
+    def __init__(self, lr: float = 1e-3, master_weights: bool = False):
+        self.lr = lr
+        self.master_weights = master_weights
+
+    # -- to be provided by subclasses -----------------------------------
+    def _init_extra(self, params: Any) -> dict:
+        raise NotImplementedError
+
+    def _update(self, extra: dict, step: jnp.ndarray, grads: Any, params: Any,
+                lr: jnp.ndarray) -> tuple:
+        """Returns (new_params_f32, new_extra).  ``params`` arrive fp32."""
+        raise NotImplementedError
+
+    # -- public API ------------------------------------------------------
+    def init(self, params: Any) -> dict:
+        state = {"step": jnp.int32(0)}
+        state.update(self._init_extra(params))
+        if self.master_weights:
+            state["master"] = jax.tree.map(
+                lambda p: jnp.asarray(p, jnp.float32), params
+            )
+        return state
+
+    def step(
+        self,
+        state: dict,
+        grads: Any,
+        params: Any,
+        lr: Optional[jnp.ndarray] = None,
+        grads_finite: Optional[jnp.ndarray] = None,
+    ) -> tuple:
+        """One optimizer step.  Returns ``(new_params, new_state)``.
+
+        ``new_params`` has the dtype of the incoming ``params`` (model
+        dtype); with master weights the update happens on the fp32 master
+        and the result is cast down, reproducing
+        ``_master_params_to_model_params``
+        (reference: apex/amp/_process_optimizer.py:14).
+        """
+        lr = f32(self.lr if lr is None else lr)
+        new_step = state["step"] + 1
+        work_params = state["master"] if self.master_weights else jax.tree.map(
+            lambda p: p.astype(jnp.float32), params
+        )
+        grads_f32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        extra = {k: v for k, v in state.items() if k not in ("step", "master")}
+        new_params_f32, new_extra = self._update(
+            extra, new_step, grads_f32, work_params, lr
+        )
+        new_state = dict(new_extra)
+        new_state["step"] = new_step
+        if self.master_weights:
+            new_state["master"] = new_params_f32
+        new_params = jax.tree.map(
+            lambda p, n: n.astype(p.dtype), params, new_params_f32
+        )
+        if grads_finite is not None:
+            new_params = tree_where(grads_finite, new_params, params)
+            new_state = tree_where(grads_finite, new_state, state)
+        return new_params, new_state
+
+    # -- optax interop ---------------------------------------------------
+    def as_optax(self):
+        import optax
+
+        opt = self
+
+        def init_fn(params):
+            return opt.init(params)
+
+        def update_fn(grads, state, params=None):
+            if params is None:
+                raise ValueError("apex_tpu fused optimizers need params")
+            new_params, new_state = opt.step(state, grads, params)
+            updates = jax.tree.map(
+                lambda n, p: n.astype(jnp.float32) - p.astype(jnp.float32),
+                new_params,
+                params,
+            )
+            return updates, new_state
+
+        return optax.GradientTransformation(init_fn, update_fn)

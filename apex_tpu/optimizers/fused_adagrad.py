@@ -1,0 +1,55 @@
+"""FusedAdagrad (reference: apex/optimizers/fused_adagrad.py:1-121,
+csrc/multi_tensor_adagrad.cu) — with the reference's ``adagrad_w_mode``
+decoupled weight decay option."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizer, f32
+
+__all__ = ["FusedAdagrad"]
+
+
+class FusedAdagrad(FusedOptimizer):
+    def __init__(
+        self,
+        lr: float = 1e-2,
+        eps: float = 1e-10,
+        weight_decay: float = 0.0,
+        adagrad_w_mode: bool = False,
+        master_weights: bool = False,
+    ):
+        super().__init__(lr=lr, master_weights=master_weights)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adagrad_w_mode = adagrad_w_mode
+
+    def _init_extra(self, params: Any) -> dict:
+        return {
+            "sum": jax.tree.map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params
+            )
+        }
+
+    def _update(self, extra, step, grads, params, lr):
+        wd = f32(self.weight_decay)
+
+        def upd(p, g, h):
+            if self.weight_decay != 0.0 and not self.adagrad_w_mode:
+                g = g + wd * p
+            h = h + jnp.square(g)
+            update = g / (jnp.sqrt(h) + self.eps)
+            if self.weight_decay != 0.0 and self.adagrad_w_mode:
+                update = update + wd * p
+            return p - lr * update, h
+
+        out = jax.tree.map(upd, params, grads, extra["sum"])
+        treedef = jax.tree.structure(params)
+        flat = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
+        new_p = jax.tree.unflatten(treedef, [t[0] for t in flat])
+        new_h = jax.tree.unflatten(treedef, [t[1] for t in flat])
+        return new_p, {"sum": new_h}

@@ -1,0 +1,25 @@
+"""FusedMixedPrecisionLamb — LAMB keeping fp32 masters + low-precision
+model params in one fused step (reference:
+apex/optimizers/fused_mixed_precision_lamb.py:1-256,
+csrc/multi_tensor_lamb_mp.cu).
+
+In this framework that capability is just ``FusedLAMB`` with
+``master_weights=True`` — the base class already performs the update on
+the fp32 master and emits model-dtype params in the same jitted step,
+which XLA fuses exactly the way multi_tensor_lamb_mp fuses the two
+writes.  Kept as its own class for API parity, with the reference's
+dynamic ``lr``/``step`` as device values (they already are, everywhere
+here).
+"""
+
+from __future__ import annotations
+
+from apex_tpu.optimizers.fused_lamb import FusedLAMB
+
+__all__ = ["FusedMixedPrecisionLamb"]
+
+
+class FusedMixedPrecisionLamb(FusedLAMB):
+    def __init__(self, *args, **kwargs):
+        kwargs["master_weights"] = True
+        super().__init__(*args, **kwargs)

@@ -1,0 +1,87 @@
+"""FusedSGD — SGD with momentum/nesterov/dampening over the pytree.
+
+Math matches torch SGD as implemented by the reference's multi-tensor
+kernel (reference: apex/optimizers/fused_sgd.py:1-227,
+csrc/multi_tensor_sgd_kernel.cu), including ``wd_after_momentum`` and the
+folded gradient ``scale`` the amp master-weight path uses
+(reference: apex/optimizers/fused_sgd.py materialize_master_grads).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizer, f32
+
+__all__ = ["FusedSGD"]
+
+
+class FusedSGD(FusedOptimizer):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        momentum: float = 0.0,
+        dampening: float = 0.0,
+        weight_decay: float = 0.0,
+        nesterov: bool = False,
+        wd_after_momentum: bool = False,
+        materialize_master_grads: bool = True,
+        master_weights: bool = False,
+    ):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires a momentum and zero dampening"
+            )
+        super().__init__(lr=lr, master_weights=master_weights)
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.wd_after_momentum = wd_after_momentum
+        self.materialize_master_grads = materialize_master_grads
+
+    def _init_extra(self, params: Any) -> dict:
+        if self.momentum == 0.0:
+            return {}
+        return {
+            "momentum_buffer": jax.tree.map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params
+            )
+        }
+
+    def _update(self, extra, step, grads, params, lr):
+        mu = f32(self.momentum)
+        damp = f32(self.dampening)
+        wd = f32(self.weight_decay)
+        first = step == 1
+
+        def upd(p, g, buf):
+            if self.weight_decay != 0.0 and not self.wd_after_momentum:
+                g = g + wd * p
+            if self.momentum != 0.0:
+                # torch semantics: buf is initialized to the first gradient
+                # (no dampening on the first step).
+                new_buf = jnp.where(first, g, mu * buf + (1.0 - damp) * g)
+                d = g + mu * new_buf if self.nesterov else new_buf
+            else:
+                new_buf = buf
+                d = g
+            if self.weight_decay != 0.0 and self.wd_after_momentum:
+                d = d + wd * p
+            return p - lr * d, new_buf
+
+        bufs = extra.get(
+            "momentum_buffer",
+            jax.tree.map(lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params),
+        )
+        out = jax.tree.map(upd, params, grads, bufs)
+        treedef = jax.tree.structure(params)
+        flat = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
+        new_p = jax.tree.unflatten(treedef, [t[0] for t in flat])
+        new_buf = jax.tree.unflatten(treedef, [t[1] for t in flat])
+        if self.momentum == 0.0:
+            return new_p, {}
+        return new_p, {"momentum_buffer": new_buf}

@@ -1,0 +1,85 @@
+"""LARC — layer-wise adaptive rate clipping/scaling.
+
+Functional form of the reference's wrapper (reference:
+apex/parallel/LARC.py:5-107): instead of mutating the wrapped
+optimizer's param groups, :func:`larc_transform` rescales the *gradients*
+so that any inner optimizer running at base ``lr`` effectively steps at
+the LARC-adjusted rate:
+
+    local_lr = trust_coefficient * ||p|| / (||g|| + weight_decay * ||p|| + eps)
+    clip mode:  g <- (g + wd*p) * min(local_lr / lr, 1)
+    scale mode: g <- (g + wd*p) * local_lr        (lr folded out by caller)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["larc_transform", "LARC"]
+
+
+def larc_transform(
+    params: Any,
+    grads: Any,
+    lr: float,
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> Any:
+    """Return LARC-adjusted gradients (see module docstring)."""
+
+    def adjust(p, g):
+        p32 = p.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+        local_lr = (
+            trust_coefficient * p_norm / (g_norm + weight_decay * p_norm + eps)
+        )
+        # reference skips adaptation when either norm is zero (LARC.py:92)
+        local_lr = jnp.where((p_norm > 0) & (g_norm > 0), local_lr, lr)
+        factor = jnp.minimum(local_lr / lr, 1.0) if clip else local_lr / lr
+        g32 = g32 + weight_decay * p32
+        return (g32 * factor).astype(g.dtype)
+
+    return jax.tree.map(adjust, params, grads)
+
+
+class LARC:
+    """Object wrapper mirroring the reference API: wraps any
+    :class:`~apex_tpu.optimizers.base.FusedOptimizer`."""
+
+    def __init__(
+        self,
+        optimizer,
+        trust_coefficient: float = 0.02,
+        clip: bool = True,
+        eps: float = 1e-8,
+    ):
+        self.optimizer = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    def init(self, params):
+        return self.optimizer.init(params)
+
+    def step(self, state, grads, params, lr=None, grads_finite=None):
+        eff_lr = self.optimizer.lr if lr is None else lr
+        wd = getattr(self.optimizer, "weight_decay", 0.0)
+        adjusted = larc_transform(
+            params,
+            grads,
+            lr=eff_lr,
+            trust_coefficient=self.trust_coefficient,
+            clip=self.clip,
+            eps=self.eps,
+            weight_decay=wd,
+        )
+        return self.optimizer.step(
+            state, adjusted, params, lr=lr, grads_finite=grads_finite
+        )

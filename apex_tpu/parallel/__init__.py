@@ -1,0 +1,34 @@
+"""apex_tpu.parallel — the data-parallel runtime.
+
+TPU-native replacement for the reference's NCCL data-parallel layer
+(reference: apex/parallel/).  The translation (SURVEY.md §7):
+
+- ``DistributedDataParallel``'s bucketed, stream-overlapped allreduce
+  → a mesh axis + one ``psum`` of the grad pytree inside the jitted step;
+  XLA's latency-hiding scheduler overlaps the collective with backward
+  compute, which is exactly what the reference's side streams hand-built.
+- ``SyncBatchNorm``'s Welford kernels → a ``psum`` of (count, Σx, Σx²)
+  over the 'dp' axis — Welford merging is unnecessary when the reduction
+  is a single fused collective.
+- ``LARC`` is re-exported from :mod:`apex_tpu.optimizers`.
+"""
+
+from apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedDataParallel,
+    all_reduce_gradients,
+    data_parallel_mesh,
+)
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    sync_batch_norm,
+)
+from apex_tpu.optimizers.larc import LARC  # noqa: F401
+
+__all__ = [
+    "DistributedDataParallel",
+    "all_reduce_gradients",
+    "data_parallel_mesh",
+    "SyncBatchNorm",
+    "sync_batch_norm",
+    "LARC",
+]

@@ -1,0 +1,7 @@
+from apex_tpu.utils.platform import (  # noqa: F401
+    is_tpu,
+    supports_pallas,
+    default_implementation,
+)
+
+__all__ = ["is_tpu", "supports_pallas", "default_implementation"]

@@ -1,0 +1,36 @@
+"""Backend detection — the analog of the reference's extension-availability
+probing (reference: apex/parallel/__init__.py:13-19, apex/amp/scaler.py:66-80):
+every fused op here has a Pallas fast path and a pure-XLA fallback, chosen
+at trace time.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["is_tpu", "supports_pallas", "default_implementation"]
+
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+@functools.lru_cache(maxsize=1)
+def is_tpu() -> bool:
+    try:
+        import jax
+
+        return jax.devices()[0].platform.lower() in _TPU_PLATFORMS
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def supports_pallas() -> bool:
+    """Whether Pallas TPU kernels can compile on the current backend."""
+    if os.environ.get("APEX_TPU_DISABLE_PALLAS"):
+        return False
+    return is_tpu()
+
+
+def default_implementation() -> str:
+    return "pallas" if supports_pallas() else "xla"

@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's test philosophy (SURVEY.md §4): smallest real
+world size, analytic expectations.  Multi-"chip" behaviour is tested on
+8 virtual CPU devices via XLA host-platform device count.
+"""
+
+import os
+
+# force CPU: the suite relies on 8 virtual devices regardless of what the
+# surrounding environment selected (e.g. a live TPU via JAX_PLATFORMS=axon)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,176 @@
+"""amp policy + scaler tests.
+
+Mirrors the reference L0 amp tier (reference: tests/L0/run_amp/): cast
+behaviour per opt level, dynamic scaler growth/backoff, checkpoint
+round-trip, per-loss scalers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+
+
+class TestPolicy:
+    def test_presets_exist(self):
+        for lvl in ["O0", "O1", "O2", "O3", "O4", "O5"]:
+            p = amp.get_policy(lvl)
+            assert p.opt_level == lvl
+
+    def test_bad_level(self):
+        with pytest.raises(ValueError):
+            amp.get_policy("O6")
+
+    def test_o0_fp32(self):
+        p = amp.get_policy("O0")
+        assert p.param_dtype == jnp.float32
+        assert p.compute_dtype == jnp.float32
+        assert p.loss_scale == 1.0
+        assert not p.master_weights
+
+    def test_o2_master_fp16(self):
+        p = amp.get_policy("O2")
+        assert p.param_dtype == jnp.float16
+        assert p.master_weights
+        assert p.loss_scale == "dynamic"
+
+    def test_o4_o5_bf16_no_scaling(self):
+        for lvl in ["O4", "O5"]:
+            p = amp.get_policy(lvl)
+            assert p.compute_dtype == jnp.bfloat16
+            assert p.loss_scale is None
+        assert amp.get_policy("O5").master_weights
+
+    def test_overrides_beat_preset(self):
+        p = amp.get_policy("O2", loss_scale=128.0, keep_norm_fp32=False)
+        assert p.loss_scale == 128.0
+        assert not p.keep_norm_fp32
+
+    def test_cast_keeps_norms_fp32(self):
+        params = {
+            "dense": {"kernel": jnp.ones((4, 4))},
+            "layernorm": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+        }
+        p = amp.get_policy("O2")
+        cast = p.cast_to_param(params)
+        assert cast["dense"]["kernel"].dtype == jnp.float16
+        assert cast["layernorm"]["scale"].dtype == jnp.float32
+
+    def test_cast_integers_untouched(self):
+        tree = {"x": jnp.ones((2,)), "i": jnp.arange(3)}
+        cast = amp.get_policy("O3").cast_to_param(tree)
+        assert cast["i"].dtype == jnp.int32
+        assert cast["x"].dtype == jnp.float16
+
+
+class TestScaler:
+    def test_static_scale(self):
+        s = amp.LossScaler(loss_scale=128.0)
+        st = s.init()
+        assert float(st.loss_scale) == 128.0
+        scaled = s.scale(st, jnp.float32(2.0))
+        assert float(scaled) == 256.0
+        st2 = s.adjust(st, jnp.bool_(True))
+        assert float(st2.loss_scale) == 128.0
+        assert int(st2.unskipped) == 1
+
+    def test_dynamic_backoff(self):
+        s = amp.LossScaler("dynamic")
+        st = s.init()
+        assert float(st.loss_scale) == 2.0 ** 16
+        st = s.adjust(st, jnp.bool_(False))
+        assert float(st.loss_scale) == 2.0 ** 15
+        assert int(st.growth_tracker) == 0
+
+    def test_dynamic_growth(self):
+        s = amp.LossScaler("dynamic", init_scale=4.0, growth_interval=3)
+        st = s.init()
+        for _ in range(2):
+            st = s.adjust(st, jnp.bool_(True))
+            assert float(st.loss_scale) == 4.0
+        st = s.adjust(st, jnp.bool_(True))
+        assert float(st.loss_scale) == 8.0
+        assert int(st.growth_tracker) == 0
+
+    def test_max_scale_clamp(self):
+        s = amp.LossScaler("dynamic", init_scale=2.0 ** 24, growth_interval=1)
+        st = s.init()
+        st = s.adjust(st, jnp.bool_(True))
+        assert float(st.loss_scale) == 2.0 ** 24
+
+    def test_unscale_detects_inf(self):
+        s = amp.LossScaler(loss_scale=2.0)
+        st = s.init()
+        grads = {"a": jnp.array([2.0, 4.0]), "b": jnp.array([jnp.inf])}
+        out, finite = s.unscale(st, grads)
+        assert not bool(finite)
+        grads = {"a": jnp.array([2.0, 4.0]), "b": jnp.array([6.0])}
+        out, finite = s.unscale(st, grads)
+        assert bool(finite)
+        np.testing.assert_allclose(out["a"], [1.0, 2.0])
+
+    def test_jit_roundtrip(self):
+        s = amp.LossScaler("dynamic")
+
+        @jax.jit
+        def step(st, g):
+            g, finite, st = s.unscale_and_adjust(st, g)
+            return g, finite, st
+
+        st = s.init()
+        g, finite, st = step(st, {"w": jnp.ones((3,))})
+        assert bool(finite)
+        assert int(st.unskipped) == 1
+
+    def test_checkpoint_roundtrip(self):
+        s = amp.LossScaler("dynamic")
+        st = s.init()
+        st = s.adjust(st, jnp.bool_(False))
+        d = s.state_dict(st)
+        st2 = s.load_state_dict(d)
+        assert float(st2.loss_scale) == float(st.loss_scale)
+        assert int(st2.growth_tracker) == int(st.growth_tracker)
+
+
+class TestMixedPrecision:
+    def test_initialize_and_per_loss_scalers(self):
+        mp = amp.initialize("O2", num_losses=3)
+        state = mp.init()
+        assert len(state.scaler_states) == 3
+        # adjust loss 1 only
+        grads = {"w": jnp.array([jnp.nan])}
+        _, finite, state = mp.unscale_and_adjust(state, grads, loss_id=1)
+        assert not bool(finite)
+        assert float(state.scaler_states[1].loss_scale) == 2.0 ** 15
+        assert float(state.scaler_states[0].loss_scale) == 2.0 ** 16
+
+    def test_state_dict_roundtrip(self):
+        mp = amp.initialize("O1", num_losses=2)
+        state = mp.init()
+        _, _, state = mp.unscale_and_adjust(
+            state, {"w": jnp.array([jnp.inf])}, loss_id=0
+        )
+        d = mp.state_dict(state)
+        state2 = mp.load_state_dict(d)
+        for a, b in zip(state.scaler_states, state2.scaler_states):
+            assert float(a.loss_scale) == float(b.loss_scale)
+
+    def test_apply_if_finite(self):
+        old = {"w": jnp.zeros((2,))}
+        new = {"w": jnp.ones((2,))}
+        kept = amp.MixedPrecision.apply_if_finite(jnp.bool_(False), old, new)
+        np.testing.assert_allclose(kept["w"], 0.0)
+        applied = amp.MixedPrecision.apply_if_finite(jnp.bool_(True), old, new)
+        np.testing.assert_allclose(applied["w"], 1.0)
+
+    def test_master_weight_flow(self):
+        mp = amp.initialize("O5")
+        params = {"dense": {"kernel": jnp.ones((2, 2))}}
+        cast, state = mp.init(params)
+        assert cast["dense"]["kernel"].dtype == jnp.bfloat16
+        master = mp.make_master(cast)
+        assert master["dense"]["kernel"].dtype == jnp.float32
+        back = mp.master_to_model(master)
+        assert back["dense"]["kernel"].dtype == jnp.bfloat16

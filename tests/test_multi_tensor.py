@@ -1,0 +1,62 @@
+"""multi_tensor primitive tests (reference analog:
+tests/L0/run_amp/test_multi_tensor_scale.py etc.)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.multi_tensor_apply import (
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+)
+
+
+def test_scale():
+    tree = {"a": jnp.array([1.0, 2.0]), "b": jnp.array([[3.0]])}
+    out, overflow = multi_tensor_scale(tree, 2.0)
+    np.testing.assert_allclose(out["a"], [2.0, 4.0])
+    np.testing.assert_allclose(out["b"], [[6.0]])
+    assert not bool(overflow)
+
+
+def test_scale_overflow_flag():
+    tree = {"a": jnp.array([1.0, jnp.inf])}
+    _, overflow = multi_tensor_scale(tree, 0.5)
+    assert bool(overflow)
+    tree = {"a": jnp.array([1.0, jnp.nan])}
+    _, overflow = multi_tensor_scale(tree, 0.5)
+    assert bool(overflow)
+
+
+def test_scale_dtype_preserved():
+    tree = {"a": jnp.ones((4,), jnp.bfloat16)}
+    out, _ = multi_tensor_scale(tree, 3.0)
+    assert out["a"].dtype == jnp.bfloat16
+
+
+def test_axpby():
+    x = {"a": jnp.array([1.0, 2.0])}
+    y = {"a": jnp.array([10.0, 20.0])}
+    out, overflow = multi_tensor_axpby(2.0, x, 0.5, y)
+    np.testing.assert_allclose(out["a"], [7.0, 14.0])
+    assert not bool(overflow)
+
+
+def test_l2norm_matches_numpy():
+    rng = np.random.RandomState(0)
+    tree = {
+        "a": jnp.asarray(rng.randn(17, 3).astype(np.float32)),
+        "b": jnp.asarray(rng.randn(5).astype(np.float32)),
+    }
+    total = multi_tensor_l2norm(tree)
+    flat = np.concatenate(
+        [np.asarray(tree["a"]).ravel(), np.asarray(tree["b"]).ravel()]
+    )
+    np.testing.assert_allclose(float(total), np.linalg.norm(flat), rtol=1e-6)
+
+
+def test_l2norm_per_tensor():
+    tree = {"a": jnp.array([3.0, 4.0]), "b": jnp.array([5.0, 12.0])}
+    total, per = multi_tensor_l2norm(tree, per_tensor=True)
+    np.testing.assert_allclose([float(p) for p in per], [5.0, 13.0])
+    np.testing.assert_allclose(float(total), np.sqrt(25 + 169))

@@ -1,0 +1,258 @@
+"""Fused optimizer tests vs torch.optim reference math
+(reference analog: tests/L0/run_optimizers/test_fused_optimizer.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.optimizers import (
+    LARC,
+    FusedAdagrad,
+    FusedAdam,
+    FusedLAMB,
+    FusedMixedPrecisionLamb,
+    FusedNovoGrad,
+    FusedSGD,
+)
+
+
+def _torch_params(np_params):
+    out = []
+    for p in np_params:
+        t = torch.tensor(p, dtype=torch.float32, requires_grad=True)
+        out.append(t)
+    return out
+
+
+def _run_jax(opt, np_params, np_grads_seq, lr=None):
+    params = {f"p{i}": jnp.asarray(p) for i, p in enumerate(np_params)}
+    state = opt.init(params)
+    step = jax.jit(lambda s, g, p: opt.step(s, g, p))
+    for np_grads in np_grads_seq:
+        grads = {f"p{i}": jnp.asarray(g) for i, g in enumerate(np_grads)}
+        params, state = step(state, grads, params)
+    return [np.asarray(params[f"p{i}"]) for i in range(len(np_params))]
+
+
+def _run_torch(topt_ctor, np_params, np_grads_seq):
+    tparams = _torch_params(np_params)
+    topt = topt_ctor(tparams)
+    for np_grads in np_grads_seq:
+        for t, g in zip(tparams, np_grads):
+            t.grad = torch.tensor(g, dtype=torch.float32)
+        topt.step()
+    return [t.detach().numpy() for t in tparams]
+
+
+def _random_problem(seed=0, steps=5):
+    rng = np.random.RandomState(seed)
+    np_params = [
+        rng.randn(7, 5).astype(np.float32),
+        rng.randn(11).astype(np.float32),
+    ]
+    grads_seq = [
+        [rng.randn(*p.shape).astype(np.float32) for p in np_params]
+        for _ in range(steps)
+    ]
+    return np_params, grads_seq
+
+
+class TestFusedAdam:
+    @pytest.mark.parametrize("adam_w_mode", [True, False])
+    @pytest.mark.parametrize("weight_decay", [0.0, 0.1])
+    def test_vs_torch(self, adam_w_mode, weight_decay):
+        np_params, grads_seq = _random_problem()
+        ours = _run_jax(
+            FusedAdam(
+                lr=1e-2, weight_decay=weight_decay, adam_w_mode=adam_w_mode
+            ),
+            np_params,
+            grads_seq,
+        )
+        ctor = (
+            (lambda ps: torch.optim.AdamW(ps, lr=1e-2, weight_decay=weight_decay))
+            if adam_w_mode
+            else (lambda ps: torch.optim.Adam(ps, lr=1e-2, weight_decay=weight_decay))
+        )
+        theirs = _run_torch(ctor, np_params, grads_seq)
+        for a, b in zip(ours, theirs):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+    def test_skip_step_on_overflow(self):
+        opt = FusedAdam(lr=0.1)
+        params = {"w": jnp.ones((3,))}
+        state = opt.init(params)
+        grads = {"w": jnp.full((3,), jnp.nan)}
+        new_params, new_state = opt.step(
+            state, grads, params, grads_finite=jnp.bool_(False)
+        )
+        np.testing.assert_allclose(new_params["w"], 1.0)
+        assert int(new_state["step"]) == 0
+
+    def test_master_weights_precision(self):
+        # bf16 params with fp32 masters should track fp32 training closely
+        opt_master = FusedAdam(lr=1e-2, master_weights=True)
+        opt_plain = FusedAdam(lr=1e-2)
+        rng = np.random.RandomState(1)
+        w0 = rng.randn(64).astype(np.float32)
+        gseq = [rng.randn(64).astype(np.float32) * 0.01 for _ in range(50)]
+
+        pm = {"w": jnp.asarray(w0, jnp.bfloat16)}
+        sm = opt_master.init(pm)
+        pf = {"w": jnp.asarray(w0)}
+        sf = opt_plain.init(pf)
+        for g in gseq:
+            pm, sm = opt_master.step(sm, {"w": jnp.asarray(g, jnp.bfloat16)}, pm)
+            pf, sf = opt_plain.step(sf, {"w": jnp.asarray(g)}, pf)
+        master = np.asarray(sm["master"]["w"])
+        full = np.asarray(pf["w"])
+        # the master starts from bf16-quantized weights, so that rounding is
+        # the noise floor; what master weights buy is *no accumulating drift*
+        # beyond it even though the model copy and grads are bf16
+        init_err = np.max(np.abs(np.asarray(jnp.asarray(w0, jnp.bfloat16), np.float32) - w0))
+        assert np.max(np.abs(master - full)) < init_err + 5e-3
+
+
+class TestFusedSGD:
+    @pytest.mark.parametrize("momentum,nesterov,wd", [
+        (0.0, False, 0.0),
+        (0.9, False, 0.0),
+        (0.9, True, 0.0),
+        (0.9, False, 0.05),
+    ])
+    def test_vs_torch(self, momentum, nesterov, wd):
+        np_params, grads_seq = _random_problem(seed=2)
+        ours = _run_jax(
+            FusedSGD(lr=0.05, momentum=momentum, nesterov=nesterov,
+                     weight_decay=wd),
+            np_params,
+            grads_seq,
+        )
+        theirs = _run_torch(
+            lambda ps: torch.optim.SGD(
+                ps, lr=0.05, momentum=momentum, nesterov=nesterov,
+                weight_decay=wd,
+            ),
+            np_params,
+            grads_seq,
+        )
+        for a, b in zip(ours, theirs):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+
+class TestFusedAdagrad:
+    def test_vs_torch(self):
+        np_params, grads_seq = _random_problem(seed=3)
+        ours = _run_jax(FusedAdagrad(lr=0.05, eps=1e-10), np_params, grads_seq)
+        theirs = _run_torch(
+            lambda ps: torch.optim.Adagrad(ps, lr=0.05, eps=1e-10),
+            np_params,
+            grads_seq,
+        )
+        for a, b in zip(ours, theirs):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+
+class TestFusedLAMB:
+    def test_decreases_loss(self):
+        # analytic fixture: quadratic loss, LAMB should descend
+        # note: LAMB's trust ratio makes steps proportional to ||p||, so a
+        # near-zero init moves slowly by design — start from a nonzero point
+        opt = FusedLAMB(lr=0.1, weight_decay=0.01)
+        target = jnp.asarray(np.linspace(-1, 1, 32).astype(np.float32))
+        params = {"w": jnp.full((32,), 0.5)}
+        state = opt.init(params)
+
+        def loss_fn(p):
+            return jnp.sum(jnp.square(p["w"] - target))
+
+        losses = []
+        for _ in range(60):
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            params, state = opt.step(state, grads, params)
+            losses.append(float(loss))
+        assert losses[-1] < 0.2 * losses[0]
+
+    def test_trust_ratio_scales_update(self):
+        # with weight_decay>0 the update magnitude is ~ lr * ||p|| per layer
+        opt = FusedLAMB(lr=0.1, weight_decay=0.01, max_grad_norm=None)
+        rng = np.random.RandomState(0)
+        big = rng.randn(16).astype(np.float32) * 100.0
+        small = rng.randn(16).astype(np.float32) * 0.01
+        params = {"big": jnp.asarray(big), "small": jnp.asarray(small)}
+        g = {"big": jnp.asarray(rng.randn(16).astype(np.float32)),
+             "small": jnp.asarray(rng.randn(16).astype(np.float32))}
+        state = opt.init(params)
+        new_params, _ = opt.step(state, g, params)
+        delta_big = np.linalg.norm(np.asarray(new_params["big"]) - big)
+        delta_small = np.linalg.norm(np.asarray(new_params["small"]) - small)
+        norm_big = np.linalg.norm(big)
+        norm_small = np.linalg.norm(small)
+        # both deltas should be ≈ lr * ||p||
+        assert abs(delta_big / norm_big - 0.1) < 0.02
+        assert abs(delta_small / norm_small - 0.1) < 0.02
+
+    def test_grad_clipping(self):
+        opt = FusedLAMB(lr=0.01, max_grad_norm=1.0)
+        params = {"w": jnp.ones((4,))}
+        state = opt.init(params)
+        huge = {"w": jnp.full((4,), 1e6)}
+        new_params, _ = opt.step(state, huge, params)
+        assert np.all(np.isfinite(np.asarray(new_params["w"])))
+
+
+class TestFusedNovoGrad:
+    def test_decreases_loss(self):
+        # NovoGrad normalizes each tensor's grad by its norm, so per-step
+        # movement is ~lr — size the fixture accordingly
+        opt = FusedNovoGrad(lr=0.1)
+        target = jnp.asarray(np.ones(16, np.float32))
+        params = {"w": jnp.zeros((16,))}
+        state = opt.init(params)
+
+        def loss_fn(p):
+            return jnp.sum(jnp.square(p["w"] - target))
+
+        first = None
+        for _ in range(150):
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            if first is None:
+                first = float(loss)
+            params, state = opt.step(state, grads, params)
+        assert float(loss_fn(params)) < 0.05 * first
+
+    def test_second_moment_is_scalar_per_tensor(self):
+        opt = FusedNovoGrad(lr=0.01)
+        params = {"w": jnp.ones((4, 4))}
+        state = opt.init(params)
+        g = {"w": jnp.full((4, 4), 2.0)}
+        _, state = opt.step(state, g, params)
+        assert np.asarray(state["exp_avg_sq"]["w"]).shape == ()
+        # first step: v = ||g||^2 = 4*16 = 64
+        np.testing.assert_allclose(float(state["exp_avg_sq"]["w"]), 64.0)
+
+
+class TestLARC:
+    def test_clip_reduces_effective_lr(self):
+        base = FusedSGD(lr=1.0)
+        larc = LARC(base, trust_coefficient=0.001)
+        params = {"w": jnp.ones((4,))}
+        state = larc.init(params)
+        g = {"w": jnp.ones((4,))}
+        new_params, _ = larc.step(state, g, params)
+        # local_lr = 0.001*||p||/||g|| = 0.001 << lr=1 → clipped
+        delta = np.abs(np.asarray(new_params["w"]) - 1.0)
+        np.testing.assert_allclose(delta, 0.001, rtol=1e-4)
+
+
+class TestMixedPrecisionLamb:
+    def test_has_master(self):
+        opt = FusedMixedPrecisionLamb(lr=0.01)
+        params = {"w": jnp.ones((8,), jnp.bfloat16)}
+        state = opt.init(params)
+        assert state["master"]["w"].dtype == jnp.float32
+        g = {"w": jnp.ones((8,), jnp.bfloat16)}
+        new_params, state = opt.step(state, g, params)
+        assert new_params["w"].dtype == jnp.bfloat16
