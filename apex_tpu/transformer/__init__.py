@@ -1,0 +1,39 @@
+"""Megatron-style model-parallel transformer toolkit, TPU-native.
+
+The reference builds its 3-D (data x tensor x pipeline) parallelism on
+torch.distributed process groups and NCCL collectives
+(reference: apex/transformer/parallel_state.py:26-397).  Here the whole
+grid is one `jax.sharding.Mesh` with named axes; collectives are XLA
+ops (`psum`, `all_gather`, `psum_scatter`, `ppermute`) emitted inside
+`shard_map`/`pjit`, and "process groups" are just axis names.
+
+Subpackages:
+
+- :mod:`apex_tpu.transformer.parallel_state`   — mesh construction + axis bookkeeping
+- :mod:`apex_tpu.transformer.tensor_parallel`  — column/row-parallel linear, vocab-parallel embedding & cross-entropy, mappings, RNG, checkpointing
+- :mod:`apex_tpu.transformer.pipeline_parallel`— 1F1B schedules, microbatch calculators
+- :mod:`apex_tpu.transformer.functional`       — fused scale-mask softmax
+- :mod:`apex_tpu.transformer.amp`              — model-parallel-consensus grad scaler
+- :mod:`apex_tpu.transformer.layers`           — transformer building blocks (attention/MLP/block)
+- :mod:`apex_tpu.transformer.testing`          — standalone GPT/BERT models for tests
+"""
+
+from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer import tensor_parallel  # noqa: F401
+from apex_tpu.transformer.enums import (  # noqa: F401
+    AttnMaskType,
+    AttnType,
+    LayerType,
+    ModelType,
+)
+from apex_tpu.transformer import utils  # noqa: F401
+
+__all__ = [
+    "parallel_state",
+    "tensor_parallel",
+    "AttnMaskType",
+    "AttnType",
+    "LayerType",
+    "ModelType",
+    "utils",
+]

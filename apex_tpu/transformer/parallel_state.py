@@ -1,0 +1,276 @@
+"""Model-parallel state: one `jax.sharding.Mesh` replaces every process group.
+
+The reference's ``initialize_model_parallel`` partitions world ranks into
+data / tensor / pipeline / model / embedding process groups
+(reference: apex/transformer/parallel_state.py:58-167).  Under
+single-controller SPMD the entire 4-D grid is *one* mesh with named axes
+
+    ("dp", "pp", "cp", "tp")
+
+ordered so the heaviest-communication axis ("tp") is innermost, mapping
+tensor-parallel collectives onto nearest-neighbour ICI links, and the
+data-parallel axis is outermost so it can span DCN on multi-pod slices —
+the TPU analog of the reference's intra-group NVLink / inter-group IB
+hierarchy (reference: apex/contrib/optimizers/distributed_fused_adam.py:115-116).
+
+"Groups" are axis names; collectives over a group are
+``psum(..., axis_name)`` inside ``shard_map``.  The embedding group (grad
+sync between first and last pipeline stage for tied embeddings,
+reference: parallel_state.py:143-167) is realized in the pipeline schedule
+by a masked ``psum`` over "pp".
+
+Rank queries come in two flavours:
+- *traced* (inside shard_map):  ``get_tensor_model_parallel_rank()`` →
+  ``lax.axis_index("tp")`` — a device-varying value;
+- *static* (host side): world sizes, virtual-pipeline bookkeeping, stage
+  ownership maps — plain python, same numbers on every host.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = [
+    "initialize_model_parallel",
+    "model_parallel_is_initialized",
+    "destroy_model_parallel",
+    "get_mesh",
+    "DATA_PARALLEL_AXIS",
+    "PIPELINE_PARALLEL_AXIS",
+    "CONTEXT_PARALLEL_AXIS",
+    "TENSOR_PARALLEL_AXIS",
+    "get_tensor_model_parallel_world_size",
+    "get_pipeline_model_parallel_world_size",
+    "get_data_parallel_world_size",
+    "get_context_parallel_world_size",
+    "get_tensor_model_parallel_rank",
+    "get_pipeline_model_parallel_rank",
+    "get_data_parallel_rank",
+    "get_context_parallel_rank",
+    "is_pipeline_first_stage",
+    "is_pipeline_last_stage",
+    "get_pipeline_model_parallel_next_rank",
+    "get_pipeline_model_parallel_prev_rank",
+    "get_virtual_pipeline_model_parallel_world_size",
+    "get_virtual_pipeline_model_parallel_rank",
+    "set_virtual_pipeline_model_parallel_rank",
+    "get_num_layers",
+]
+
+DATA_PARALLEL_AXIS = "dp"
+PIPELINE_PARALLEL_AXIS = "pp"
+CONTEXT_PARALLEL_AXIS = "cp"
+TENSOR_PARALLEL_AXIS = "tp"
+
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    context_parallel_size_: int = 1,
+    *,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build and install the global 4-D mesh.
+
+    Mirrors the grid arithmetic of the reference
+    (reference: apex/transformer/parallel_state.py:58-107): the world size
+    must be divisible by tp*pp*cp and dp is the quotient.  Returns the
+    mesh; also installs it as the module-global so the getters work.
+    """
+    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+
+    devices = list(devices if devices is not None else jax.devices())
+    world = len(devices)
+    tp = tensor_model_parallel_size_
+    pp = pipeline_model_parallel_size_
+    cp = context_parallel_size_
+    denom = tp * pp * cp
+    if world % denom != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by "
+            f"tensor ({tp}) x pipeline ({pp}) x context ({cp}) parallel sizes"
+        )
+    dp = world // denom
+
+    if virtual_pipeline_model_parallel_size_ is not None:
+        if pp <= 2 and virtual_pipeline_model_parallel_size_ > 1:
+            raise RuntimeError(
+                "pipeline-model-parallel size should be greater than 2 with "
+                "interleaved schedule"
+            )
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = 0
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = (
+            virtual_pipeline_model_parallel_size_
+        )
+    else:
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+
+    grid = np.asarray(devices).reshape(dp, pp, cp, tp)
+    _MESH = Mesh(
+        grid,
+        (
+            DATA_PARALLEL_AXIS,
+            PIPELINE_PARALLEL_AXIS,
+            CONTEXT_PARALLEL_AXIS,
+            TENSOR_PARALLEL_AXIS,
+        ),
+    )
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    """(reference: apex/transformer/parallel_state.py:169-175)"""
+    return _MESH is not None
+
+
+def destroy_model_parallel() -> None:
+    """(reference: apex/transformer/parallel_state.py:373-397)"""
+    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    _MESH = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError(
+            "model parallel mesh is not initialized — call "
+            "initialize_model_parallel() first"
+        )
+    return _MESH
+
+
+# -- world sizes (static, host-side) ------------------------------------
+
+def _axis_size(axis: str) -> int:
+    return get_mesh().shape[axis]
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _axis_size(TENSOR_PARALLEL_AXIS)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _axis_size(PIPELINE_PARALLEL_AXIS)
+
+
+def get_data_parallel_world_size() -> int:
+    return _axis_size(DATA_PARALLEL_AXIS)
+
+
+def get_context_parallel_world_size() -> int:
+    return _axis_size(CONTEXT_PARALLEL_AXIS)
+
+
+# -- ranks (traced; valid only inside shard_map over the mesh) ----------
+
+def get_tensor_model_parallel_rank():
+    """Device-varying rank on the tp axis — call inside shard_map
+    (reference: apex/transformer/parallel_state.py:243-252)."""
+    return jax.lax.axis_index(TENSOR_PARALLEL_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PIPELINE_PARALLEL_AXIS)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index(DATA_PARALLEL_AXIS)
+
+
+def get_context_parallel_rank():
+    return jax.lax.axis_index(CONTEXT_PARALLEL_AXIS)
+
+
+# -- pipeline stage predicates ------------------------------------------
+
+def is_pipeline_first_stage(stage: Optional[int] = None, ignore_virtual: bool = False):
+    """True iff the given (or traced) pipeline stage is stage 0.
+
+    With a static ``stage`` this is host-side python (used by the schedule
+    builder); with ``stage=None`` it returns a traced boolean via
+    ``axis_index`` (reference: apex/transformer/parallel_state.py:300-316).
+    Virtual-pipeline semantics: only the first model chunk on stage 0 is
+    "first" unless ``ignore_virtual``.
+    """
+    if not ignore_virtual:
+        vrank = get_virtual_pipeline_model_parallel_rank()
+        if vrank is not None and vrank != 0:
+            return False
+    if stage is None:
+        return get_pipeline_model_parallel_rank() == 0
+    return stage == 0
+
+
+def is_pipeline_last_stage(stage: Optional[int] = None, ignore_virtual: bool = False):
+    """(reference: apex/transformer/parallel_state.py:318-334)"""
+    if not ignore_virtual:
+        vrank = get_virtual_pipeline_model_parallel_rank()
+        vworld = get_virtual_pipeline_model_parallel_world_size()
+        if vworld is not None and vrank != vworld - 1:
+            return False
+    last = get_pipeline_model_parallel_world_size() - 1
+    if stage is None:
+        return get_pipeline_model_parallel_rank() == last
+    return stage == last
+
+
+def get_pipeline_model_parallel_next_rank(stage: int) -> int:
+    """Static next-stage index with wraparound
+    (reference: apex/transformer/parallel_state.py:349-354)."""
+    return (stage + 1) % get_pipeline_model_parallel_world_size()
+
+
+def get_pipeline_model_parallel_prev_rank(stage: int) -> int:
+    """(reference: apex/transformer/parallel_state.py:356-360)"""
+    return (stage - 1) % get_pipeline_model_parallel_world_size()
+
+
+# -- virtual pipeline (interleaved schedule) bookkeeping ----------------
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = rank
+
+
+def get_num_layers(total_layers: int, is_encoder_and_decoder_model: bool = False) -> int:
+    """Layers owned by one pipeline stage
+    (reference: apex/transformer/parallel_state.py — layer split logic used
+    by build_model)."""
+    pp = get_pipeline_model_parallel_world_size()
+    if is_encoder_and_decoder_model:
+        raise NotImplementedError(
+            "encoder_and_decoder pipeline layer split not yet implemented"
+        )
+    if total_layers % pp != 0:
+        raise ValueError(
+            f"num_layers ({total_layers}) must be divisible by pipeline size ({pp})"
+        )
+    return total_layers // pp
+
+
+def pipeline_stage_layers(total_layers: int) -> List[range]:
+    """Static map: which layer indices live on each pipeline stage."""
+    pp = get_pipeline_model_parallel_world_size()
+    per = get_num_layers(total_layers)
+    return [range(s * per, (s + 1) * per) for s in range(pp)]

@@ -1,0 +1,53 @@
+"""Tensor-parallel primitives (Megatron TP), TPU-native.
+
+Everything here runs *inside* ``shard_map`` over the mesh built by
+:mod:`apex_tpu.transformer.parallel_state`: each device holds its local
+shard of the weights and the collectives are explicit XLA ops on the
+"tp" axis.  Autograd through the collectives is what the reference
+implements by hand as autograd.Functions
+(reference: apex/transformer/tensor_parallel/mappings.py:23-159) — here
+they are `jax.custom_vjp` wrappers with identical forward/backward
+semantics.
+"""
+
+from apex_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
+    copy_to_tensor_model_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (  # noqa: F401
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    state_specs_like,
+)
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (  # noqa: F401
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.random import (  # noqa: F401
+    checkpoint,
+    model_parallel_key,
+    data_parallel_key,
+)
+from apex_tpu.transformer.tensor_parallel.utils import (  # noqa: F401
+    VocabUtility,
+    split_tensor_along_last_dim,
+)
+
+__all__ = [
+    "copy_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "state_specs_like",
+    "vocab_parallel_cross_entropy",
+    "checkpoint",
+    "model_parallel_key",
+    "data_parallel_key",
+    "VocabUtility",
+    "split_tensor_along_last_dim",
+]

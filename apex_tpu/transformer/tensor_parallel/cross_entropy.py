@@ -1,0 +1,56 @@
+"""Vocab-parallel softmax cross-entropy.
+
+Same math as the reference autograd function
+(reference: apex/transformer/tensor_parallel/cross_entropy.py:23-103):
+max-logit all-reduce → stable exp → sum-exp all-reduce → masked target
+logit all-reduce → loss = log(sum_exp) − target_logit.  The backward
+(softmax minus one-hot, reference :78-103) falls out of autodiff through
+the psums; the max is stop-gradiented exactly as the reference treats it
+as a constant.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+
+__all__ = ["vocab_parallel_cross_entropy"]
+
+
+def vocab_parallel_cross_entropy(
+    vocab_parallel_logits: jnp.ndarray,
+    target: jnp.ndarray,
+    axis_name: str = TENSOR_PARALLEL_AXIS,
+) -> jnp.ndarray:
+    """Per-token CE loss from vocab-sharded logits — call inside shard_map.
+
+    ``vocab_parallel_logits``: (..., vocab/tp) local shard.
+    ``target``: (...) int ids in the *global* vocab.
+    Returns (...) float32 losses.
+    """
+    logits = vocab_parallel_logits.astype(jnp.float32)
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    per = logits.shape[-1]
+    start = rank * per
+
+    # global max for stability, treated as a constant like the reference
+    # (reference :31-39) — pmax has no JVP rule, so stop-gradient first
+    local_max = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
+    global_max = jax.lax.pmax(local_max, axis_name)
+    logits = logits - global_max[..., None]
+
+    # log-sum-exp over the global vocab (reference :55-63)
+    exp_logits = jnp.exp(logits)
+    sum_exp = jax.lax.psum(jnp.sum(exp_logits, axis=-1), axis_name)
+
+    # target logit: only the owning shard contributes (reference :41-53)
+    in_range = (target >= start) & (target < start + per)
+    local_target = jnp.where(in_range, target - start, 0)
+    picked = jnp.take_along_axis(logits, local_target[..., None], axis=-1)[..., 0]
+    picked = jnp.where(in_range, picked, 0.0)
+    target_logit = jax.lax.psum(picked, axis_name)
+
+    return jnp.log(sum_exp) - target_logit

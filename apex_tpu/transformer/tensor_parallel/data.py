@@ -1,0 +1,31 @@
+"""Broadcast batch data from tensor-parallel rank 0
+(reference: apex/transformer/tensor_parallel/data.py:77-116).
+
+Under single-controller SPMD every device already receives the batch the
+host gave it, so the usual reason for this primitive (only TP rank 0
+loads data) disappears.  It is kept for parity and for shard_map code
+that wants to *guarantee* tp-uniformity of a value computed per-device.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+
+__all__ = ["broadcast_data"]
+
+
+def broadcast_data(tree: Any, axis_name: str = TENSOR_PARALLEL_AXIS) -> Any:
+    """Replace every leaf with tensor-parallel rank 0's copy — a masked
+    psum, the collective-of-choice for small broadcasts on ICI."""
+    rank = jax.lax.axis_index(axis_name)
+
+    def bcast(x):
+        x = jnp.where(rank == 0, x, jnp.zeros_like(x))
+        return jax.lax.psum(x, axis_name)
+
+    return jax.tree.map(bcast, tree)

@@ -1,0 +1,45 @@
+"""Vocab-range arithmetic and tensor splitting
+(reference: apex/transformer/tensor_parallel/utils.py:20-54)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["VocabUtility", "split_tensor_along_last_dim"]
+
+
+def split_tensor_along_last_dim(x: jnp.ndarray, num_partitions: int) -> Sequence:
+    """Static split of the last dim into equal chunks
+    (reference: apex/transformer/tensor_parallel/utils.py:20-34)."""
+    last = x.shape[-1]
+    if last % num_partitions != 0:
+        raise ValueError(
+            f"last dim {last} not divisible by num_partitions {num_partitions}"
+        )
+    return jnp.split(x, num_partitions, axis=-1)
+
+
+class VocabUtility:
+    """Which [first, last) vocab slice a TP rank owns
+    (reference: apex/transformer/tensor_parallel/utils.py:37-54)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(
+        global_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        if global_vocab_size % world_size != 0:
+            raise ValueError(
+                f"vocab size {global_vocab_size} not divisible by tp {world_size}"
+            )
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            global_vocab_size // world_size, rank, world_size
+        )

@@ -3,16 +3,24 @@
 Mirrors the reference's test philosophy (SURVEY.md §4): smallest real
 world size, analytic expectations.  Multi-"chip" behaviour is tested on
 8 virtual CPU devices via XLA host-platform device count.
+
+The environment may pre-register a TPU PJRT plugin at interpreter start
+(sitecustomize) and force ``jax_platforms`` to prefer it; backend
+discovery would then dial the TPU from every test process.  Overriding
+at the *config* level (not just the env var) wins over that hook, and
+XLA_FLAGS must be set before the first backend initialization.
 """
 
 import os
 
-# force CPU: the suite relies on 8 virtual devices regardless of what the
-# surrounding environment selected (e.g. a live TPU via JAX_PLATFORMS=axon)
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
