@@ -14,6 +14,15 @@ from apex_tpu.ops.layer_norm import (  # noqa: F401
     fused_rms_norm_affine,
     mixed_dtype_fused_layer_norm_affine,
 )
+from apex_tpu.ops.softmax import (  # noqa: F401
+    scaled_softmax,
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.ops.attention import (  # noqa: F401
+    flash_attention,
+    mha_reference,
+)
 
 __all__ = [
     "fused_layer_norm",
@@ -21,4 +30,9 @@ __all__ = [
     "fused_rms_norm",
     "fused_rms_norm_affine",
     "mixed_dtype_fused_layer_norm_affine",
+    "scaled_softmax",
+    "scaled_masked_softmax",
+    "scaled_upper_triang_masked_softmax",
+    "flash_attention",
+    "mha_reference",
 ]

@@ -1,0 +1,436 @@
+"""Flash attention for TPU (Pallas), plus a reference XLA path.
+
+Capability match — and supersession — of the reference's attention stack:
+``fmhalib`` (apex/contrib/csrc/fmha/, fp16, seqlen<=512, SM80-only) and the
+eight ``fast_*_multihead_attn`` extensions
+(apex/contrib/csrc/multihead_attn/).  Those kernels materialise the
+(sq, sk) score matrix per head; flash attention never does, so the TPU
+design has no seqlen window: one online-softmax kernel covers every
+sequence length, causal or not, bf16-first.
+
+Layout: ``(batch, heads, seq, head_dim)``.  Softmax statistics are fp32;
+the accumulator is fp32; output matches the input dtype.
+
+Kernel strategy (chosen for VMEM residency, see pallas_guide):
+- forward: grid ``(batch*heads, q_blocks)``; K/V for the whole sequence
+  sit in VMEM per program (S=8k in bf16 is ~2 MB each at d=128) and the
+  kernel walks K in ``block_k`` slices with a ``fori_loop`` whose trip
+  count shrinks under causal masking.
+- backward: two kernels — dK/dV over ``(batch*heads, k_blocks)`` and dQ
+  over ``(batch*heads, q_blocks)`` — both replaying scores from the saved
+  log-sum-exp, the standard flash-attention-2 recomputation split.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.platform import is_tpu, supports_pallas
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+__all__ = ["flash_attention", "mha_reference"]
+
+_NEG_INF = -1e30
+
+
+def mha_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    bias: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Plain XLA attention with fp32 softmax — the correctness reference,
+    playing the role of the reference's pure-PyTorch ``impl='default'``
+    path (apex/contrib/multihead_attn/self_multihead_attn_func.py)."""
+    d = q.shape[-1]
+    scale = (1.0 / d**0.5) if sm_scale is None else sm_scale
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2:]
+        q_idx = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_idx = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(k_idx > q_idx, _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+
+
+
+
+def _interpret() -> bool:
+    """Interpreter-mode Pallas off-TPU: the kernel bodies still run (and
+    are testable) on CPU, at interpreter speed."""
+    return not is_tpu()
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward
+# ---------------------------------------------------------------------------
+
+
+def _fa_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref,
+    *, sm_scale, causal, block_q, block_k, kv_len,
+):
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (block_q, d)
+    d = q.shape[-1]
+    num_k = pl.cdiv(kv_len, block_k)
+    if causal:
+        # blocks wholly above the diagonal contribute nothing
+        num_k = jnp.minimum(
+            num_k, pl.cdiv((j + 1) * block_q, block_k)
+        )
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                 # (block_q, block_k)
+        k_global = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        mask = k_global < kv_len
+        if causal:
+            q_global = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            mask = jnp.logical_and(mask, k_global <= q_global)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_k, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+
+
+def _fa_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    kv_len = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, kv_len)
+    pad_q = (-sq) % block_q
+    pad_k = (-kv_len) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    psq, psk = sq + pad_q, kv_len + pad_k
+    grid = (bh, psq // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fa_fwd_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, kv_len=kv_len,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, psk, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, psk, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, psq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    lse = lse[:, 0]
+    if pad_q:
+        out, lse = out[:, :sq], lse[:, :sq]
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward
+# ---------------------------------------------------------------------------
+
+
+def _fa_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, sm_scale, causal, block_q, block_k, q_len,
+):
+    kb = pl.program_id(1)
+    kblk = k_ref[0].astype(jnp.float32)                   # (block_k, d)
+    vblk = v_ref[0].astype(jnp.float32)
+    d = kblk.shape[-1]
+    num_q = pl.cdiv(q_len, block_q)
+    start_q = 0
+    if causal:
+        start_q = (kb * block_k) // block_q
+
+    def body(jq, carry):
+        dk, dv = carry
+        qblk = q_ref[0, pl.ds(jq * block_q, block_q), :].astype(jnp.float32)
+        doblk = do_ref[0, pl.ds(jq * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(jq * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(jq * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            qblk, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                                      # (block_q, block_k)
+        q_global = jq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0
+        )
+        k_global = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        mask = q_global < q_len
+        if causal:
+            mask = jnp.logical_and(mask, k_global <= q_global)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, doblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            doblk, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jax.lax.dot_general(
+            ds, qblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((kblk.shape[0], d), jnp.float32)
+    dv0 = jnp.zeros((vblk.shape[0], d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_q, num_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, sm_scale, causal, block_q, block_k, kv_len,
+):
+    j = pl.program_id(1)
+    qblk = q_ref[0].astype(jnp.float32)                   # (block_q, d)
+    doblk = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    d = qblk.shape[-1]
+    num_k = pl.cdiv(kv_len, block_k)
+    if causal:
+        num_k = jnp.minimum(num_k, pl.cdiv((j + 1) * block_q, block_k))
+
+    def body(kb, dq):
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qblk, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        k_global = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        mask = k_global < kv_len
+        if causal:
+            q_global = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            mask = jnp.logical_and(mask, k_global <= q_global)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            doblk, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(
+        0, num_k, body, jnp.zeros((qblk.shape[0], d), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _fa_bwd_pallas(q, k, v, out, lse, do, sm_scale, causal,
+                   block_q, block_k):
+    bh, sq, d = q.shape
+    kv_len = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, kv_len)
+    pad_q = (-sq) % block_q
+    pad_k = (-kv_len) % block_k
+    # delta = rowsum(do * o) — cheap, XLA fuses it
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+    padq = lambda x: jnp.pad(x, ((0, 0), (0, pad_q), (0, 0))) if pad_q else x
+    padk = lambda x: jnp.pad(x, ((0, 0), (0, pad_k), (0, 0))) if pad_k else x
+    qp, dop = padq(q), padq(do)
+    kp, vp = padk(k), padk(v)
+    lsep = jnp.pad(lse, ((0, 0), (0, pad_q))) if pad_q else lse
+    deltap = jnp.pad(delta, ((0, 0), (0, pad_q))) if pad_q else delta
+    lsep = lsep[:, None, :]
+    deltap = deltap[:, None, :]
+    psq, psk = sq + pad_q, kv_len + pad_k
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, q_len=sq,
+        ),
+        grid=(bh, psk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, psq, d), lambda i, kb: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, psq, d), lambda i, kb: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, psq), lambda i, kb: (i, 0, 0)),
+            pl.BlockSpec((1, 1, psq), lambda i, kb: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, psk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, psk, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, kv_len=kv_len,
+        ),
+        grid=(bh, psq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, psk, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, psk, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    if pad_q:
+        dq = dq[:, :sq]
+    if pad_k:
+        dk, dv = dk[:, :kv_len], dv[:, :kv_len]
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper (flattened (b*h, s, d) layout)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k):
+    out, _ = _fa_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    out, lse = _fa_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _fa_bwd_pallas(
+        q, k, v, out, lse, do, sm_scale, causal, block_q, block_k
+    )
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    bias: Optional[jnp.ndarray] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    implementation: Optional[str] = None,
+) -> jnp.ndarray:
+    """Flash attention over ``(batch, heads, seq, head_dim)``.
+
+    ``implementation`` is ``"pallas"`` (TPU kernel) or ``"xla"``
+    (reference path, also the CPU fallback); default picks by platform.
+    ``bias`` (additive mask) currently routes to the XLA path.
+    """
+    impl = implementation or ("pallas" if supports_pallas() else "xla")
+    if impl != "pallas" or pl is None or bias is not None:
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                             bias=bias)
+    b, h, sq, d = q.shape
+    scale = (1.0 / d**0.5) if sm_scale is None else float(sm_scale)
+    # pad head_dim to the 128-lane tile; zero columns do not change
+    # q@k^T, and padded output columns are sliced off
+    pad_d = (-d) % 128
+    if pad_d:
+        padd = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, pad_d)))
+        q, k, v = padd(q), padd(k), padd(v)
+    flat = lambda x: x.reshape(b * h, x.shape[2], x.shape[3])
+    out = _flash(flat(q), flat(k), flat(v), scale, causal,
+                 block_q, block_k)
+    out = out.reshape(b, h, sq, d + pad_d)
+    if pad_d:
+        out = out[..., :d]
+    return out

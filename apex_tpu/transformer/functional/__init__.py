@@ -1,0 +1,8 @@
+"""Fused functional ops for the transformer toolkit
+(reference: apex/transformer/functional/__init__.py)."""
+
+from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
+    FusedScaleMaskSoftmax,
+)
+
+__all__ = ["FusedScaleMaskSoftmax"]

@@ -1,0 +1,101 @@
+"""Scale-mask-softmax dispatcher for attention scores.
+
+TPU re-design of the reference's ``FusedScaleMaskSoftmax``
+(reference: apex/transformer/functional/fused_softmax.py:105-199): the
+module that decides, per call, whether attention scores take the fused
+kernel or the composed fallback.  Differences by design:
+
+- The CUDA kernels only accept ``16 < sk <= 2048``, ``sq % 4 == 0``,
+  ``(b*np) % 4 == 0`` (reference ``is_kernel_available``, lines 151-171);
+  the Pallas kernel tiles any shape, so kernel availability reduces to
+  "is there a TPU" — preserved as a method for API parity.
+- ``softmax_in_fp32`` is honoured by both paths here (fp32 statistics are
+  the kernels' contract anyway).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import (
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.utils.platform import supports_pallas
+
+__all__ = ["FusedScaleMaskSoftmax"]
+
+
+class FusedScaleMaskSoftmax:
+    """Fused operation: scaling + mask + softmax.
+
+    Args mirror the reference (apex/transformer/functional/fused_softmax.py:118-128):
+        input_in_fp16 / input_in_bf16: declared input precision (sanity only)
+        attn_mask_type: AttnMaskType.padding or .causal
+        scaled_masked_softmax_fusion: use the fused kernel when available
+        mask_func: fallback-path mask function ``f(scores, mask) -> scores``
+        softmax_in_fp32: compute softmax statistics in fp32
+        scale: score scaling factor (requires softmax_in_fp32)
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = True,
+        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func: Optional[Callable] = None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+    ):
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError("both fp16 and bf16 flags cannot be active")
+        if scale is not None and not softmax_in_fp32:
+            raise RuntimeError("softmax should be in fp32 when scaled")
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """Platform gate; shape windows intentionally dropped (docstring)."""
+        return bool(
+            self.scaled_masked_softmax_fusion
+            and self.input_in_float16
+            and supports_pallas()
+        )
+
+    def __call__(
+        self, x: jnp.ndarray, mask: Optional[jnp.ndarray]
+    ) -> jnp.ndarray:
+        """``x``: (b, np, sq, sk) attention scores; ``mask``: boolean,
+        True entries masked out, broadcastable to ``x`` (or None)."""
+        assert x.ndim == 4
+        scale = 1.0 if self.scale is None else self.scale
+        if self.attn_mask_type == AttnMaskType.causal:
+            # unlike the reference kernel (which asserts sq == sk and takes
+            # no mask, line 181), padding masks compose with causal here
+            if mask is not None:
+                return scaled_masked_softmax(x, mask, scale, causal=True)
+            return scaled_upper_triang_masked_softmax(x, scale)
+        if mask is not None:
+            if self.mask_func is not None and not self.is_kernel_available(
+                mask, *x.shape
+            ):
+                # composed fallback mirrors torch_fwd (lines 184-199)
+                xs = x.astype(jnp.float32) if self.softmax_in_fp32 else x
+                xs = self.mask_func(xs * scale, mask)
+                ex = jnp.exp(xs - jnp.max(xs, axis=-1, keepdims=True))
+                return (ex / jnp.sum(ex, axis=-1, keepdims=True)).astype(
+                    x.dtype
+                )
+            return scaled_masked_softmax(x, mask, scale)
+        return scaled_softmax(x, scale)

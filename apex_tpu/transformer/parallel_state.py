@@ -89,6 +89,11 @@ def initialize_model_parallel(
     global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
 
+    if _MESH is not None:
+        # the reference raises on double-init too; call
+        # destroy_model_parallel() first to re-grid
+        raise RuntimeError("model parallel is already initialized")
+
     devices = list(devices if devices is not None else jax.devices())
     world = len(devices)
     tp = tensor_model_parallel_size_

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
 
 __all__ = ["vocab_parallel_cross_entropy"]
 
@@ -34,7 +35,9 @@ def vocab_parallel_cross_entropy(
     world = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     per = logits.shape[-1]
-    start = rank * per
+    start, end = VocabUtility.vocab_range_from_per_partition_vocab_size(
+        per, rank, world
+    )
 
     # global max for stability, treated as a constant like the reference
     # (reference :31-39) — pmax has no JVP rule, so stop-gradient first
@@ -47,7 +50,7 @@ def vocab_parallel_cross_entropy(
     sum_exp = jax.lax.psum(jnp.sum(exp_logits, axis=-1), axis_name)
 
     # target logit: only the owning shard contributes (reference :41-53)
-    in_range = (target >= start) & (target < start + per)
+    in_range = (target >= start) & (target < end)
     local_target = jnp.where(in_range, target - start, 0)
     picked = jnp.take_along_axis(logits, local_target[..., None], axis=-1)[..., 0]
     picked = jnp.where(in_range, picked, 0.0)

@@ -25,7 +25,9 @@ def broadcast_data(tree: Any, axis_name: str = TENSOR_PARALLEL_AXIS) -> Any:
     rank = jax.lax.axis_index(axis_name)
 
     def bcast(x):
-        x = jnp.where(rank == 0, x, jnp.zeros_like(x))
-        return jax.lax.psum(x, axis_name)
+        x = jnp.asarray(x)
+        masked = jnp.where(rank == 0, x, jnp.zeros_like(x))
+        # psum promotes bool (and weak ints) — restore the leaf dtype
+        return jax.lax.psum(masked, axis_name).astype(x.dtype)
 
     return jax.tree.map(bcast, tree)

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
     gather_from_tensor_model_parallel_region,
@@ -244,10 +245,11 @@ class VocabParallelEmbedding:
         w = params["weight"]
         world = jax.lax.axis_size(self.axis_name)
         rank = jax.lax.axis_index(self.axis_name)
-        per = self.num_embeddings // world
-        start = rank * per
+        start, end = VocabUtility.vocab_range_from_per_partition_vocab_size(
+            self.num_embeddings // world, rank, world
+        )
         # mask + shift (reference: layers.py:177-196)
-        in_range = (ids >= start) & (ids < start + per)
+        in_range = (ids >= start) & (ids < end)
         local_ids = jnp.where(in_range, ids - start, 0)
         out = jnp.take(w, local_ids, axis=0)
         out = jnp.where(in_range[..., None], out, jnp.zeros_like(out))
