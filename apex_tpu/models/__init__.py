@@ -1,0 +1,11 @@
+"""Model families built on the transformer toolkit.
+
+The reference keeps its standalone GPT/BERT under
+``apex/transformer/testing`` because they exist only to exercise the
+tensor/pipeline toolkit; here they are first-class models (and the
+flagship benchmark drivers).
+"""
+
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+
+__all__ = ["GPTConfig", "GPTModel"]

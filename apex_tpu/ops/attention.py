@@ -29,7 +29,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.utils.platform import is_tpu, supports_pallas
+from apex_tpu.utils.platform import default_implementation, is_tpu
 
 try:
     from jax.experimental import pallas as pl
@@ -415,7 +415,7 @@ def flash_attention(
     (reference path, also the CPU fallback); default picks by platform.
     ``bias`` (additive mask) currently routes to the XLA path.
     """
-    impl = implementation or ("pallas" if supports_pallas() else "xla")
+    impl = implementation or default_implementation()
     if impl != "pallas" or pl is None or bias is not None:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                              bias=bias)

@@ -28,7 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.utils.platform import supports_pallas
+from apex_tpu.utils.platform import default_implementation
 
 __all__ = [
     "scaled_softmax",
@@ -131,7 +131,7 @@ def _softmax_fwd_xla(
 
 
 def _softmax_fwd(x3d, mask, scale, causal, implementation):
-    impl = implementation or ("pallas" if supports_pallas() else "xla")
+    impl = implementation or default_implementation()
     if impl == "pallas" and mask is None and pl is not None:
         try:
             return _softmax_fwd_pallas(x3d, scale, causal)

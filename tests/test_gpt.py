@@ -1,0 +1,140 @@
+"""GPT model tests: tp-sharded forward/loss/grad vs dense math, on the
+8-device virtual CPU mesh (SURVEY.md §4 philosophy — smallest real mesh,
+analytic/dense-reference expectations; mirrors the reference's
+run_megatron_gpt_pipeline.py end-to-end tier)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.transformer import parallel_state
+
+
+def small_config(**kw):
+    base = dict(
+        vocab_size=64,
+        num_layers=2,
+        hidden_size=32,
+        num_attention_heads=4,
+        max_position_embeddings=16,
+        compute_dtype=jnp.float32,
+        remat=False,
+        attention_impl="xla",
+    )
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def build(mesh, model):
+    """jit(shard_map(loss)) + matching param placement."""
+    specs = model.param_specs()
+
+    def loss_fn(params, tokens, targets):
+        return model.loss(params, tokens, targets)
+
+    sharded = jax.jit(
+        jax.shard_map(
+            loss_fn,
+            mesh=mesh,
+            in_specs=(specs, P("dp"), P("dp")),
+            out_specs=P(),
+        )
+    )
+    return sharded, specs
+
+
+def test_gpt_loss_tp_invariant():
+    """The same logical params give (numerically) the same loss on a
+    tp=1 and a tp=4 mesh."""
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 12), 0, 64)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (8, 12), 0, 64)
+    losses = {}
+    for tp in (1, 4):
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=tp
+        )
+        try:
+            model = GPTModel(small_config())
+            params = model.init(jax.random.PRNGKey(0))
+            sharded, specs = build(mesh, model)
+            placed = jax.device_put(
+                params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                     is_leaf=lambda x: isinstance(x, P))
+            )
+            losses[tp] = float(sharded(placed, tokens, targets))
+            assert np.isfinite(losses[tp])
+        finally:
+            parallel_state.destroy_model_parallel()
+    np.testing.assert_allclose(losses[4], losses[1], rtol=2e-4)
+
+
+def test_gpt_grads_finite_and_remat_matches():
+    mesh = parallel_state.initialize_model_parallel(tensor_model_parallel_size_=2)
+    try:
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 64)
+        targets = jax.random.randint(jax.random.PRNGKey(2), (4, 8), 0, 64)
+        losses = {}
+        grads = {}
+        for remat in (False, True):
+            model = GPTModel(small_config(remat=remat))
+            params = model.init(jax.random.PRNGKey(0))
+            specs = model.param_specs()
+            grad_fn = jax.jit(
+                jax.shard_map(
+                    jax.value_and_grad(lambda p, t, y: model.loss(p, t, y)),
+                    mesh=mesh,
+                    in_specs=(specs, P("dp"), P("dp")),
+                    out_specs=(P(), specs),
+                )
+            )
+            placed = jax.device_put(
+                params,
+                jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P)),
+            )
+            loss, g = grad_fn(placed, tokens, targets)
+            losses[remat] = float(loss)
+            grads[remat] = g
+            flat = jax.tree.leaves(g)
+            assert all(np.all(np.isfinite(np.asarray(x))) for x in flat)
+        np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(grads[False]), jax.tree.leaves(grads[True])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                       atol=1e-6)
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_gpt_dropout_rng_paths():
+    mesh = parallel_state.initialize_model_parallel(tensor_model_parallel_size_=2)
+    try:
+        model = GPTModel(
+            small_config(hidden_dropout=0.1, attention_dropout=0.1)
+        )
+        params = model.init(jax.random.PRNGKey(0))
+        specs = model.param_specs()
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 64)
+
+        def fwd(params, tokens, rng):
+            return model.apply(params, tokens, rng)
+
+        sharded = jax.jit(
+            jax.shard_map(
+                fwd,
+                mesh=mesh,
+                in_specs=(specs, P("dp"), P()),
+                out_specs=P("dp", None, "tp"),
+            )
+        )
+        placed = jax.device_put(
+            params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        )
+        a = sharded(placed, tokens, jax.random.PRNGKey(3))
+        b = sharded(placed, tokens, jax.random.PRNGKey(4))
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+    finally:
+        parallel_state.destroy_model_parallel()
