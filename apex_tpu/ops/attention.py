@@ -29,6 +29,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.ops.common import shape_struct
 from apex_tpu.utils.platform import default_implementation, is_tpu
 
 try:
@@ -169,8 +170,8 @@ def _fa_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k):
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, psq), jnp.float32),
+            shape_struct((bh, psq, d), q.dtype, qp, kp, vp),
+            shape_struct((bh, 1, psq), jnp.float32, qp, kp, vp),
         ],
         interpret=_interpret(),
     )(qp, kp, vp)
@@ -334,8 +335,8 @@ def _fa_bwd_pallas(q, k, v, out, lse, do, sm_scale, causal,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, psk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, psk, d), v.dtype),
+            shape_struct((bh, psk, d), k.dtype, qp, kp, vp, dop),
+            shape_struct((bh, psk, d), v.dtype, qp, kp, vp, dop),
         ],
         interpret=_interpret(),
     )(qp, kp, vp, dop, lsep, deltap)
@@ -360,7 +361,7 @@ def _fa_bwd_pallas(q, k, v, out, lse, do, sm_scale, causal,
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
+        out_shape=shape_struct((bh, psq, d), q.dtype, qp, kp, vp, dop),
         interpret=_interpret(),
     )(qp, kp, vp, dop, lsep, deltap)
 

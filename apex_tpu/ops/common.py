@@ -1,0 +1,20 @@
+"""Shared helpers for the Pallas kernels."""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shape_struct"]
+
+
+def shape_struct(shape, dtype, *varying_like) -> jax.ShapeDtypeStruct:
+    """A ``ShapeDtypeStruct`` whose ``vma`` (varying-across-mesh axes) is
+    the union of the given operands' — required so ``pallas_call`` results
+    type-check under ``shard_map(check_vma=True)``, e.g. when a kernel
+    runs on dp-sharded activations inside a tensor-parallel region."""
+    try:
+        sets = [jax.typeof(x).vma for x in varying_like]
+        vma = frozenset().union(*sets) if sets else frozenset()
+    except Exception:
+        vma = None
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)

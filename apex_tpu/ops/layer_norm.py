@@ -25,6 +25,8 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.ops.common import shape_struct
+
 from apex_tpu.utils.platform import supports_pallas
 
 __all__ = [
@@ -63,8 +65,10 @@ def _ln_fwd_kernel(x_ref, o_ref, mean_ref, invvar_ref, *, eps, rms):
     var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
     invvar = jax.lax.rsqrt(var + eps)
     o_ref[:] = ((x - mean) * invvar).astype(o_ref.dtype)
-    mean_ref[:] = mean[:, 0]
-    invvar_ref[:] = invvar[:, 0]
+    # stats are written (1, rows)-shaped: Mosaic requires lane-tiled 2-D
+    # layouts; 1-D f32 outputs mis-tile against XLA ({T(256)} vs {T(1024)})
+    mean_ref[0, :] = mean[:, 0]
+    invvar_ref[0, :] = invvar[:, 0]
 
 
 def _ln_fwd_pallas(x2d: jnp.ndarray, eps: float, rms: bool):
@@ -89,15 +93,16 @@ def _ln_fwd_pallas(x2d: jnp.ndarray, eps: float, rms: bool):
         out_specs=[
             pl.BlockSpec((block_rows, hidden), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
+            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((padded_rows, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((padded_rows,), jnp.float32),
-            jax.ShapeDtypeStruct((padded_rows,), jnp.float32),
+            shape_struct((padded_rows, hidden), x2d.dtype, x2d),
+            shape_struct((1, padded_rows), jnp.float32, x2d),
+            shape_struct((1, padded_rows), jnp.float32, x2d),
         ],
     )(x2d)
+    mean, invvar = mean[0], invvar[0]
     if pad:
         out, mean, invvar = out[:rows], mean[:rows], invvar[:rows]
     return out, mean, invvar

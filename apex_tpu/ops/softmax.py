@@ -28,6 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.ops.common import shape_struct
 from apex_tpu.utils.platform import default_implementation
 
 __all__ = [
@@ -103,7 +104,7 @@ def _softmax_fwd_pallas(x3d: jnp.ndarray, scale: float, causal: bool):
             (1, block_q, sk), lambda i, j: (i, j, 0),
             memory_space=pltpu.VMEM,
         ),
-        out_shape=jax.ShapeDtypeStruct((m, padded_sq, sk), x3d.dtype),
+        out_shape=shape_struct((m, padded_sq, sk), x3d.dtype, x3d),
         interpret=_interpret(),
     )(x3d)
     if pad:

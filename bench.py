@@ -1,0 +1,126 @@
+"""Benchmark: flagship GPT training-step throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "gpt_tp1_tokens_per_sec", "value": N, "unit": "tokens/s",
+   "vs_baseline": R}
+
+``vs_baseline`` is the speedup of the framework's fast path (bf16 compute
++ flash attention + fused master-weight Adam — the amp-O5 analog) over an
+O0-analog baseline measured in the same run (fp32 compute, XLA attention,
+same optimizer math).  The reference publishes no numeric baselines
+(BASELINE.md), so the baseline is measured, not copied.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel.layers import state_specs_like
+
+BATCH = 8
+SEQ = 1024
+WARMUP = 2
+STEPS = 10
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_step(fast: bool):
+    if parallel_state.model_parallel_is_initialized():
+        parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel()
+    cfg = GPTConfig(
+        vocab_size=32768,
+        num_layers=12,
+        hidden_size=1024,
+        num_attention_heads=8,  # head_dim 128 = one MXU lane tile
+        max_position_embeddings=SEQ,
+        compute_dtype=jnp.bfloat16 if fast else jnp.float32,
+        attention_impl=None if fast else "xla",
+        remat=True,
+    )
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    specs = model.param_specs()
+    opt = FusedAdam(lr=1e-4, master_weights=fast)
+    opt_state = opt.init(params)
+    opt_specs = state_specs_like(specs, opt_state)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(model.loss)(params, tokens, targets)
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+        new_params, new_opt = opt.step(opt_state, grads, params)
+        return new_params, new_opt, loss
+
+    step = jax.jit(
+        jax.shard_map(
+            train_step,
+            mesh=mesh,
+            in_specs=(specs, opt_specs, P("dp"), P("dp")),
+            out_specs=(specs, opt_specs, P()),
+        ),
+        donate_argnums=(0, 1),
+    )
+    place = lambda tree, sp: jax.device_put(
+        tree,
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), sp,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+    )
+    if fast:
+        # bf16 model params, fp32 masters live in the optimizer state
+        params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+    return place(params, specs), place(opt_state, opt_specs), step
+
+
+def run(fast: bool) -> float:
+    params, opt_state, step = build_step(fast)
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (BATCH, SEQ), 0, 32768)
+    targets = jnp.roll(tokens, -1, axis=1)
+    for _ in range(WARMUP):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    # host readback, not block_until_ready: the axon tunnel backend's
+    # block_until_ready returns before device execution completes, and the
+    # data dependency through `loss` is what forces the whole step chain
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert jnp.isfinite(final_loss), "non-finite loss in benchmark"
+    tps = BATCH * SEQ * STEPS / dt
+    log(f"{'fast' if fast else 'base'}: {dt/STEPS*1e3:.1f} ms/step, "
+        f"{tps:,.0f} tokens/s, loss {final_loss:.3f}")
+    return tps
+
+
+def main():
+    log(f"devices: {jax.devices()}")
+    base = run(fast=False)
+    fast = run(fast=True)
+    print(
+        json.dumps(
+            {
+                "metric": "gpt_tp1_tokens_per_sec",
+                "value": round(fast, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(fast / base, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
