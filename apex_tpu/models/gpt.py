@@ -355,3 +355,75 @@ class GPTModel:
         )
         loss = jnp.mean(per_token)
         return jax.lax.pmean(loss, DATA_PARALLEL_AXIS)
+
+    # ------------------------------------------------------ pipeline path
+    def pipeline_param_specs(self) -> Dict[str, Any]:
+        """Param specs with the stacked-layer dim sharded over "pp", so
+        each pipeline stage holds its own num_layers/pp layers."""
+        from apex_tpu.transformer.pipeline_parallel import (
+            pipeline_stage_specs,
+        )
+
+        specs = self.param_specs()
+        specs["layers"] = pipeline_stage_specs(specs["layers"])
+        return specs
+
+    def pipeline_loss(
+        self,
+        params: Dict[str, Any],
+        tokens: jnp.ndarray,
+        targets: jnp.ndarray,
+        num_microbatches: int,
+    ) -> jnp.ndarray:
+        """Mean next-token CE through the compiled pipeline schedule —
+        call inside shard_map with params placed by
+        :meth:`pipeline_param_specs`.  ``params["layers"]`` is then the
+        local stage's layer stack.  After ``jax.grad`` of this, apply
+        ``pipeline_parallel.sync_replicated_grads`` for the tied
+        embedding / shared-param grad sync."""
+        from apex_tpu.transformer.pipeline_parallel import pipeline
+
+        c = self.config
+        b, s = tokens.shape
+        if b % num_microbatches:
+            raise ValueError(
+                f"local batch ({b}) must be divisible by "
+                f"num_microbatches ({num_microbatches})"
+            )
+        mb = b // num_microbatches
+        mbs = {
+            "tokens": tokens.reshape(num_microbatches, mb, s),
+            "targets": targets.reshape(num_microbatches, mb, s),
+        }
+
+        def first_fn(m):
+            x = self.embedding.apply(params["embedding"], m["tokens"])
+            x = x + params["pos_embedding"][:s][None, :, :].astype(x.dtype)
+            return x.astype(c.compute_dtype)
+
+        def stage_fn(x):
+            def body(h, lp):
+                return self._layer(lp, h, None), None
+
+            out, _ = jax.lax.scan(body, x, params["layers"])
+            return out
+
+        def last_fn(x, m):
+            x = fused_layer_norm_affine(
+                x.astype(jnp.float32),
+                params["final_ln"]["scale"],
+                params["final_ln"]["bias"],
+                (c.hidden_size,),
+                eps=c.layernorm_epsilon,
+            ).astype(c.compute_dtype)
+            logits = self.logits(params, x)
+            per_token = vocab_parallel_cross_entropy(
+                logits, m["targets"], axis_name=self.axis_name
+            )
+            return jnp.mean(per_token)
+
+        per_micro = pipeline(
+            first_fn, stage_fn, last_fn, mbs, remat=c.remat
+        )
+        loss = jnp.mean(per_micro)
+        return jax.lax.pmean(loss, DATA_PARALLEL_AXIS)

@@ -1,0 +1,112 @@
+"""Pipeline parallelism: compiled SPMD schedules over the "pp" mesh axis.
+
+Components (reference: apex/transformer/pipeline_parallel/):
+- :mod:`schedules` — the compiled pipeline (scan + ppermute) and the
+  no-pipelining fallback, plus ``get_forward_backward_func`` dispatch
+- :mod:`p2p_communication` — ppermute ring-shift primitives
+- :mod:`microbatches` — microbatch calculators incl. batch-size rampup
+- :func:`pipeline_stage_specs` — shard a stacked-layer param pytree over
+  the pipeline axis (the analog of ``build_model``'s per-rank layer
+  assignment, reference: schedules/common.py:18-108)
+"""
+
+from typing import Any
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer.parallel_state import PIPELINE_PARALLEL_AXIS
+from apex_tpu.transformer.pipeline_parallel.microbatches import (
+    build_num_microbatches_calculator,
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+)
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+    send_backward,
+    send_backward_recv_forward,
+    send_forward,
+    send_forward_recv_backward,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    pipeline,
+)
+
+__all__ = [
+    "pipeline",
+    "pipeline_stage_specs",
+    "sync_replicated_grads",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "get_forward_backward_func",
+    "send_forward",
+    "send_backward",
+    "send_forward_recv_backward",
+    "send_backward_recv_forward",
+    "build_num_microbatches_calculator",
+    "ConstantNumMicroBatches",
+    "RampupBatchsizeNumMicroBatches",
+]
+
+
+def pipeline_stage_specs(
+    stacked_layer_specs: Any, axis_name: str = PIPELINE_PARALLEL_AXIS
+) -> Any:
+    """Shard the stacked-layer dim over the pipeline axis: each rank then
+    holds its own contiguous ``num_layers/pp`` layers — the analog of the
+    reference's per-rank layer assignment in ``build_model``
+    (reference: schedules/common.py:18-108).  Input specs are the
+    per-layer specs *with* the stacked leading dim (as produced by e.g.
+    ``GPTModel.param_specs()["layers"]``, whose leading dim is ``None``)."""
+
+    def stage(spec: P) -> P:
+        if len(spec) and spec[0] is not None:
+            raise ValueError(
+                f"stacked-layer dim already sharded: {spec}"
+            )
+        return P(axis_name, *spec[1:])
+
+    return jax.tree.map(stage, stacked_layer_specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def sync_replicated_grads(
+    grads: Any, specs: Any, axis_name: str = PIPELINE_PARALLEL_AXIS
+) -> Any:
+    """psum over the pipeline axis the grads of params that are
+    *replicated* across stages (embedding, lm head, final norm): each
+    stage only sees its own contribution, and for tied embeddings this is
+    exactly the reference's embedding-group grad all-reduce between the
+    first and last pipeline stages
+    (reference: apex/transformer/parallel_state.py:143-167).
+
+    Under ``shard_map(check_vma=True)`` (the default) this sync already
+    happens inside autodiff — the transpose of the implicit
+    replicated→varying cast is a psum — so the helper checks each grad's
+    varying-axes set and only psums leaves that still vary over the
+    pipeline axis, making it a safe no-op in the default mode and the
+    required fix-up when vma checking is off.  Grads of stage-sharded
+    params (spec mentions the pipeline axis) pass through untouched.
+    Call inside shard_map, after ``jax.grad``."""
+    from jax import lax
+
+    def fix(g, s):
+        names = []
+        for entry in s:
+            if isinstance(entry, (tuple, list)):
+                names.extend(entry)
+            elif entry is not None:
+                names.append(entry)
+        if axis_name in names:
+            return g
+        try:
+            if axis_name not in jax.typeof(g).vma:
+                return g
+        except Exception:
+            pass
+        return lax.psum(g, axis_name)
+
+    return jax.tree.map(fix, grads, specs,
+                        is_leaf=lambda x: isinstance(x, P))

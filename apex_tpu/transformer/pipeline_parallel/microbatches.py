@@ -1,0 +1,135 @@
+"""Microbatch calculators, including batch-size rampup.
+
+Same bookkeeping as the reference
+(reference: apex/transformer/pipeline_parallel/microbatches.py:21-172):
+a constant calculator and a rampup calculator that grows the global batch
+size linearly in increments over consumed samples.  Pure host-side Python
+— these numbers feed static shapes, so they must be Python ints.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = [
+    "build_num_microbatches_calculator",
+    "ConstantNumMicroBatches",
+    "RampupBatchsizeNumMicroBatches",
+]
+
+
+class ConstantNumMicroBatches:
+    """(reference: microbatches.py:118-139)"""
+
+    def __init__(self, global_batch_size: int, micro_batch_size: int,
+                 data_parallel_size: int):
+        micro_times_dp = micro_batch_size * data_parallel_size
+        if global_batch_size % micro_times_dp != 0:
+            raise ValueError(
+                f"global batch size ({global_batch_size}) is not divisible by "
+                f"micro batch size ({micro_batch_size}) times data-parallel "
+                f"size ({data_parallel_size})"
+            )
+        self.micro_batch_size = micro_batch_size
+        self.num_micro_batches = global_batch_size // micro_times_dp
+        self.current_global_batch_size = global_batch_size
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples: int, consistency_check: bool = True):
+        pass
+
+
+class RampupBatchsizeNumMicroBatches:
+    """Linear global-batch-size rampup
+    (reference: microbatches.py:142-172): batch grows from
+    ``start_batch_size`` to ``global_batch_size`` in ``batch_size_increment``
+    steps spread over ``ramup_samples`` consumed samples."""
+
+    def __init__(
+        self,
+        start_batch_size: int,
+        batch_size_increment: int,
+        ramup_samples: int,
+        global_batch_size: int,
+        micro_batch_size: int,
+        data_parallel_size: int,
+    ):
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+        self.micro_batch_times_data_parallel = (
+            micro_batch_size * data_parallel_size
+        )
+        if start_batch_size % self.micro_batch_times_data_parallel != 0:
+            raise ValueError(
+                "start batch size must be divisible by "
+                "micro-batch-size * data-parallel-size"
+            )
+        diff = global_batch_size - start_batch_size
+        if diff % batch_size_increment != 0:
+            raise ValueError(
+                f"expected global batch size interval ({diff}) to be divisible "
+                f"by global batch size increment ({batch_size_increment})"
+            )
+        num_increments = diff // batch_size_increment
+        self.rampup_samples_per_increment = (
+            self.ramup_samples / num_increments if num_increments else 0
+        )
+        self.update(0, False)
+
+    def update(self, consumed_samples: int, consistency_check: bool = True):
+        if consumed_samples > self.ramup_samples:
+            current = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            current = self.start_batch_size + steps * self.batch_size_increment
+            if current > self.global_batch_size:
+                current = self.global_batch_size
+        if current % self.micro_batch_times_data_parallel != 0:
+            if consistency_check:
+                raise ValueError(
+                    f"current global batch size ({current}) is not divisible "
+                    "by micro-batch-size * data-parallel-size"
+                )
+            current -= current % self.micro_batch_times_data_parallel
+        self.current_global_batch_size = current
+        self.num_micro_batches = (
+            current // self.micro_batch_times_data_parallel
+        )
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+
+def build_num_microbatches_calculator(
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+    rampup_batch_size: Optional[list] = None,
+):
+    """(reference: microbatches.py:21-55)"""
+    if rampup_batch_size is None:
+        return ConstantNumMicroBatches(
+            global_batch_size, micro_batch_size, data_parallel_size
+        )
+    if len(rampup_batch_size) != 3:
+        raise ValueError(
+            "expected the following format: --rampup-batch-size "
+            "<start batch size> <batch size increment> <ramp-up samples>"
+        )
+    start, inc, samples = (int(v) for v in rampup_batch_size)
+    return RampupBatchsizeNumMicroBatches(
+        start, inc, samples, global_batch_size, micro_batch_size,
+        data_parallel_size,
+    )

@@ -1,0 +1,190 @@
+"""Pipeline-parallel schedules, compiled.
+
+The reference drives 1F1B with an imperative Python loop of per-microbatch
+isend/irecv and ``torch.autograd.backward`` calls
+(reference: apex/transformer/pipeline_parallel/schedules/
+fwd_bwd_pipelining_without_interleaving.py:22-170).  That host-driven
+schedule is the single biggest design divergence for TPU (SURVEY.md §7):
+under XLA the whole pipeline must be ONE compiled program.
+
+Design here: the *forward* pipeline is a ``lax.scan`` over
+``num_microbatches + pp - 1`` ticks inside ``shard_map``; each tick every
+stage applies its stage function and the activations rotate one stage
+forward with ``ppermute``.  Differentiating the scanned program yields the
+reverse pipeline automatically — ``ppermute``'s transpose is the opposite
+rotation — so backward needs no schedule code at all.  Memory behaves
+like GPipe (all microbatch activations live until backward); wrapping the
+stage function in ``jax.checkpoint`` (``remat=True``) recovers the
+1F1B-like activation footprint by keeping only per-tick stage inputs and
+recomputing the rest, which is the standard TPU trade (FLOPs are cheaper
+than HBM).
+
+The user-facing surface mirrors the reference:
+- :func:`forward_backward_no_pipelining`    (fwd_bwd_no_pipelining.py:29-91)
+- :func:`forward_backward_pipelining_without_interleaving`
+- :func:`get_forward_backward_func`         (schedules/__init__.py:1-39)
+
+but each returns a **loss function** to differentiate, because on TPU
+"forward+backward" is ``jax.grad`` of the compiled loss, not a schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import PIPELINE_PARALLEL_AXIS
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+    send_forward,
+)
+
+__all__ = [
+    "pipeline",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "get_forward_backward_func",
+]
+
+
+def _index_microbatch(microbatches: Any, i) -> Any:
+    return jax.tree.map(
+        lambda x: lax.dynamic_index_in_dim(x, i, 0, keepdims=False),
+        microbatches,
+    )
+
+
+def _where_tree(cond, a: Any, b: Any) -> Any:
+    return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)
+
+
+def pipeline(
+    first_fn: Callable[[Any], Any],
+    stage_fn: Callable[[Any], Any],
+    last_fn: Callable[[Any, Any], jnp.ndarray],
+    microbatches: Any,
+    *,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Run the compiled SPMD pipeline; returns per-microbatch results.
+
+    - ``first_fn(mb)``: the pipeline entry (e.g. embedding) — logically
+      stage 0's preamble.  Must return the activation pytree that flows
+      through stages; every stage's output must have the same structure
+      (homogeneous stages, as in a transformer stack).
+    - ``stage_fn(x)``: one pipeline stage.  Close over the *local* stage
+      params (sharded ``P("pp", ...)`` so each rank holds its own stage).
+    - ``last_fn(y, mb)``: the pipeline exit on the final stage (e.g. LM
+      head + loss against the microbatch's targets).  Must return a
+      scalar or fixed-shape array per microbatch.
+    - ``microbatches``: pytree with a leading ``num_microbatches`` dim,
+      replicated over the pipeline axis.
+
+    Returns the stacked ``last_fn`` results, one per microbatch,
+    replicated over the pipeline axis.  Differentiate through this for
+    the backward pipeline.
+    """
+    pp = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    num_micro = jax.tree.leaves(microbatches)[0].shape[0]
+    ticks = num_micro + pp - 1
+
+    mb0 = _index_microbatch(microbatches, 0)
+    # the carry must match the loop body's type exactly, including its
+    # varying-across-mesh axes: derive it from a real entry activation
+    # (multiply-by-zero keeps the vma) and mark it varying over the
+    # pipeline axis, which ppermute introduces inside the loop
+    zeros_state = jax.tree.map(
+        lambda a: lax.pcast(a * 0, axis_name, to="varying"),
+        first_fn(mb0),
+    )
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(stage_fn)
+
+    def tick(state, t):
+        # fresh microbatch enters at stage 0 (clamped index; the tail
+        # ticks feed stage 0 garbage that never reaches last_fn's mask)
+        mb_in = _index_microbatch(
+            microbatches, jnp.minimum(t, num_micro - 1)
+        )
+        entry = first_fn(mb_in)
+        x = _where_tree(stage == 0, entry, state)
+        y = body(x)
+        # exit at the last stage: microbatch index t-(pp-1)
+        out_idx = jnp.maximum(t - (pp - 1), 0)
+        mb_out = _index_microbatch(microbatches, out_idx)
+        r = last_fn(y, mb_out)
+        r = jnp.where(stage == pp - 1, r, jnp.zeros_like(r))
+        # rotate activations to the next stage
+        state = send_forward(y, axis_name)
+        return state, r
+
+    _, results = lax.scan(tick, zeros_state, jnp.arange(ticks))
+    # keep the ticks where the last stage produced real microbatches,
+    # then replicate them across the pipeline axis (only the last
+    # stage's contribution is nonzero)
+    valid = results[pp - 1 :]
+    return lax.psum(valid, axis_name)
+
+
+def forward_backward_no_pipelining(
+    first_fn: Callable,
+    stage_fn: Callable,
+    last_fn: Callable,
+    microbatches: Any,
+    *,
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Sequential microbatch loop, no pipeline axis involved
+    (reference: fwd_bwd_no_pipelining.py:29-91 — its grad-sync context
+    manager is unnecessary here: grads of a scanned loss accumulate by
+    construction)."""
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(stage_fn)
+
+    def one(mb):
+        return last_fn(body(first_fn(mb)), mb)
+
+    def step(carry, mb):
+        return carry, one(mb)
+
+    _, results = lax.scan(step, (), microbatches)
+    return results
+
+
+def forward_backward_pipelining_without_interleaving(
+    first_fn: Callable,
+    stage_fn: Callable,
+    last_fn: Callable,
+    microbatches: Any,
+    *,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Reference-parity name for :func:`pipeline`
+    (reference: fwd_bwd_pipelining_without_interleaving.py:22-170)."""
+    return pipeline(
+        first_fn, stage_fn, last_fn, microbatches,
+        axis_name=axis_name, remat=remat,
+    )
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_size: int = 1,
+):
+    """(reference: schedules/__init__.py:1-39)"""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            raise NotImplementedError(
+                "interleaved virtual-pipeline schedule is not implemented "
+                "yet; use the non-interleaved compiled pipeline"
+            )
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

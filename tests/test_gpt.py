@@ -108,6 +108,74 @@ def test_gpt_grads_finite_and_remat_matches():
         parallel_state.destroy_model_parallel()
 
 
+def test_gpt_pipeline_matches_non_pipeline():
+    """pp=2 x tp=2 x dp=2 pipeline loss+grads == single-mesh loss+grads."""
+    from apex_tpu.transformer.pipeline_parallel import sync_replicated_grads
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 8), 0, 64)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (8, 8), 0, 64)
+
+    # dense reference: tp=1 pp=1 mesh
+    mesh = parallel_state.initialize_model_parallel()
+    try:
+        model = GPTModel(small_config())
+        params = model.init(jax.random.PRNGKey(0))
+        sharded, specs = build(mesh, model)
+        grad_fn = jax.jit(
+            jax.shard_map(
+                jax.value_and_grad(lambda p, t, y: model.loss(p, t, y)),
+                mesh=mesh,
+                in_specs=(specs, P("dp"), P("dp")),
+                out_specs=(P(), specs),
+            )
+        )
+        ref_loss, ref_grads = grad_fn(params, tokens, targets)
+        ref_loss = float(ref_loss)
+        ref_grads = jax.device_get(ref_grads)
+    finally:
+        parallel_state.destroy_model_parallel()
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2, pipeline_model_parallel_size_=2
+    )
+    try:
+        model = GPTModel(small_config())
+        params = model.init(jax.random.PRNGKey(0))
+        specs = model.pipeline_param_specs()
+
+        def pp_loss_and_grad(params, tokens, targets):
+            loss, grads = jax.value_and_grad(model.pipeline_loss)(
+                params, tokens, targets, 2
+            )
+            grads = sync_replicated_grads(grads, specs)
+            return loss, grads
+
+        grad_fn = jax.jit(
+            jax.shard_map(
+                pp_loss_and_grad,
+                mesh=mesh,
+                in_specs=(specs, P("dp"), P("dp")),
+                out_specs=(P(), specs),
+            )
+        )
+        placed = jax.device_put(
+            params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        )
+        loss, grads = grad_fn(placed, tokens, targets)
+        np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-4)
+        for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(jax.device_get(grads)),
+            jax.tree_util.tree_leaves_with_path(ref_grads),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-3, atol=1e-5,
+                err_msg=str(ka),
+            )
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
 def test_gpt_dropout_rng_paths():
     mesh = parallel_state.initialize_model_parallel(tensor_model_parallel_size_=2)
     try:

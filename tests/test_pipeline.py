@@ -1,0 +1,196 @@
+"""Pipeline-parallel schedule tests on the 8-device virtual CPU mesh.
+
+Philosophy (SURVEY.md §4): the reference tests its schedules with a tiny
+linear model and analytic/serial expectations
+(tests/L0/run_transformer/run_pipeline_parallel_test.py); here the
+compiled pp=4 pipeline (and its autodiff backward) is compared against
+the identical serial computation on one device.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+    forward_backward_no_pipelining,
+    get_forward_backward_func,
+    pipeline,
+    pipeline_stage_specs,
+)
+
+NUM_LAYERS = 4
+HIDDEN = 16
+MICRO = 8  # microbatches
+MB = 2     # rows per microbatch (per dp shard)
+
+
+def make_params(key):
+    """Stacked dense layers: (L, h, h) weights + (L, h) biases."""
+    kw, kb = jax.random.split(key)
+    return {
+        "w": 0.3 * jax.random.normal(kw, (NUM_LAYERS, HIDDEN, HIDDEN)),
+        "b": 0.01 * jax.random.normal(kb, (NUM_LAYERS, HIDDEN)),
+    }
+
+
+def serial_loss(params, x, y):
+    """Dense single-device reference: all layers, full batch, MSE."""
+    h = x
+    for l in range(NUM_LAYERS):
+        h = jnp.tanh(h @ params["w"][l] + params["b"][l])
+    return jnp.mean((h - y) ** 2)
+
+
+def _stage_scan(local_params, x):
+    def body(h, lp):
+        return jnp.tanh(h @ lp["w"] + lp["b"]), None
+
+    out, _ = jax.lax.scan(body, x, local_params)
+    return out
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_pipeline_matches_serial(remat):
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4
+    )
+    try:
+        params = make_params(jax.random.PRNGKey(0))
+        layer_specs = {"w": P(None, None, None), "b": P(None, None)}
+        stage_specs = pipeline_stage_specs(layer_specs)
+        dp = mesh.shape["dp"]
+        x = jax.random.normal(jax.random.PRNGKey(1), (MICRO * MB * dp, HIDDEN))
+        y = jax.random.normal(jax.random.PRNGKey(2), (MICRO * MB * dp, HIDDEN))
+
+        def pp_loss(params, x, y):
+            # local dp shard → microbatches
+            mbs = {
+                "x": x.reshape(MICRO, MB, HIDDEN),
+                "y": y.reshape(MICRO, MB, HIDDEN),
+            }
+            per_micro = pipeline(
+                first_fn=lambda mb: mb["x"],
+                stage_fn=lambda h: _stage_scan(params, h),
+                last_fn=lambda h, mb: jnp.mean((h - mb["y"]) ** 2),
+                microbatches=mbs,
+                remat=remat,
+            )
+            return jax.lax.pmean(jnp.mean(per_micro), "dp")
+
+        grad_fn = jax.jit(
+            jax.shard_map(
+                jax.value_and_grad(pp_loss),
+                mesh=mesh,
+                in_specs=(stage_specs, P("dp"), P("dp")),
+                out_specs=(P(), stage_specs),
+            )
+        )
+        placed = jax.device_put(
+            params,
+            jax.tree.map(lambda s: NamedSharding(mesh, s), stage_specs,
+                         is_leaf=lambda x: isinstance(x, P)),
+        )
+        loss, grads = grad_fn(placed, x, y)
+
+        ref_loss, ref_grads = jax.value_and_grad(serial_loss)(params, x, y)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_no_pipelining_matches_serial():
+    mesh = parallel_state.initialize_model_parallel()
+    try:
+        params = make_params(jax.random.PRNGKey(0))
+        dp = mesh.shape["dp"]
+        x = jax.random.normal(jax.random.PRNGKey(1), (MICRO * MB * dp, HIDDEN))
+        y = jax.random.normal(jax.random.PRNGKey(2), (MICRO * MB * dp, HIDDEN))
+
+        def loss_fn(params, x, y):
+            mbs = {
+                "x": x.reshape(MICRO, MB, HIDDEN),
+                "y": y.reshape(MICRO, MB, HIDDEN),
+            }
+            per_micro = forward_backward_no_pipelining(
+                first_fn=lambda mb: mb["x"],
+                stage_fn=lambda h: _stage_scan(params, h),
+                last_fn=lambda h, mb: jnp.mean((h - mb["y"]) ** 2),
+                microbatches=mbs,
+            )
+            return jax.lax.pmean(jnp.mean(per_micro), "dp")
+
+        specs = {"w": P(), "b": P()}
+        grad_fn = jax.jit(
+            jax.shard_map(
+                jax.value_and_grad(loss_fn),
+                mesh=mesh,
+                in_specs=(specs, P("dp"), P("dp")),
+                out_specs=(P(), specs),
+            )
+        )
+        loss, grads = grad_fn(params, x, y)
+        ref_loss, ref_grads = jax.value_and_grad(serial_loss)(params, x, y)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_get_forward_backward_func_dispatch():
+    assert (
+        get_forward_backward_func(None, 4)
+        is not forward_backward_no_pipelining
+    )
+    assert (
+        get_forward_backward_func(None, 1) is forward_backward_no_pipelining
+    )
+    with pytest.raises(NotImplementedError):
+        get_forward_backward_func(2, 4)
+
+
+class TestMicrobatchCalculators:
+    def test_constant(self):
+        calc = build_num_microbatches_calculator(64, 4, 2)
+        assert isinstance(calc, ConstantNumMicroBatches)
+        assert calc.get() == 8
+        assert calc.get_current_global_batch_size() == 64
+        calc.update(10_000)
+        assert calc.get() == 8
+
+    def test_constant_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            ConstantNumMicroBatches(10, 4, 2)
+
+    def test_rampup(self):
+        calc = build_num_microbatches_calculator(
+            64, 4, 2, rampup_batch_size=[8, 8, 700]
+        )
+        assert isinstance(calc, RampupBatchsizeNumMicroBatches)
+        assert calc.get_current_global_batch_size() == 8
+        assert calc.get() == 1
+        calc.update(100)  # one increment per 100 samples
+        assert calc.get_current_global_batch_size() == 16
+        calc.update(700)
+        assert calc.get_current_global_batch_size() == 64
+        calc.update(10_000)
+        assert calc.get_current_global_batch_size() == 64
+        assert calc.get() == 8
+
+    def test_rampup_bad_increment(self):
+        with pytest.raises(ValueError):
+            build_num_microbatches_calculator(
+                64, 4, 2, rampup_batch_size=[8, 9, 700]
+            )
