@@ -1,0 +1,1 @@
+"""Contrib tier: the TPU-native equivalents of ``apex.contrib``."""

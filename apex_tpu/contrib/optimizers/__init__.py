@@ -1,0 +1,9 @@
+"""Distributed (ZeRO-style) optimizers
+(reference: apex/contrib/optimizers/)."""
+
+from apex_tpu.contrib.optimizers.distributed import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)
+
+__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]

@@ -1,0 +1,298 @@
+"""ZeRO-style distributed optimizers: state sharded over the dp axis.
+
+Capability match of the reference's ``DistributedFusedAdam`` /
+``DistributedFusedLAMB``
+(reference: apex/contrib/optimizers/distributed_fused_adam.py:9-636,
+distributed_fused_lamb.py:10-910): gradients are **reduce-scattered**
+across data-parallel ranks, each rank runs the optimizer step on its own
+1/dp shard of a flat fp32 buffer (moments and fp32 masters live only for
+that shard), and the updated parameters are **all-gathered** back.
+
+TPU-native redesign: the reference's flat-buffer block/chunk machinery,
+multiple process-group pools (``dwu_num_rs_pg/ar_pg/ag_pg``) and manual
+stream pipelining exist to overlap NCCL with CUDA compute; under XLA the
+collectives (``psum_scatter`` / ``all_gather`` over the "dp" mesh axis)
+are scheduled and overlapped by the compiler, and the two-level
+intra/inter-group hierarchy maps onto nested mesh axes (ICI inside a
+pod, DCN across pods) without optimizer involvement.  What remains is
+the math — ~150 lines instead of ~4k.
+
+LAMB's per-parameter trust ratios survive flat sharding via segment
+reductions: each flat element carries its parameter id, per-parameter
+partial norms are ``segment_sum``-ed locally and ``psum``-ed across the
+shard boundary, so the trust ratio is bitwise the same as the unsharded
+optimizer.
+
+Call :meth:`init` and :meth:`step` inside ``shard_map``; state specs come
+from :meth:`state_specs`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.optimizers.base import f32, tree_where
+from apex_tpu.transformer.parallel_state import DATA_PARALLEL_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    all_gather_invariant,
+)
+
+__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]
+
+
+class _FlatMeta:
+    """Host-side flattening metadata for a param pytree."""
+
+    def __init__(self, params: Any, world: int):
+        leaves = jax.tree.leaves(params)
+        self.treedef = jax.tree.structure(params)
+        self.shapes = [jnp.shape(l) for l in leaves]
+        self.dtypes = [jnp.asarray(l).dtype for l in leaves]
+        self.sizes = [int(jnp.size(l)) for l in leaves]
+        self.total = sum(self.sizes)
+        self.padded = -(-self.total // world) * world
+        self.shard = self.padded // world
+        self.num_leaves = len(leaves)
+
+    def flatten(self, tree: Any) -> jnp.ndarray:
+        leaves = jax.tree.leaves(tree)
+        flat = jnp.concatenate(
+            [jnp.ravel(l).astype(jnp.float32) for l in leaves]
+        )
+        return jnp.pad(flat, (0, self.padded - self.total))
+
+    def unflatten(self, flat: jnp.ndarray) -> Any:
+        out, off = [], 0
+        for shape, dtype, size in zip(self.shapes, self.dtypes, self.sizes):
+            out.append(flat[off : off + size].reshape(shape).astype(dtype))
+            off += size
+        return jax.tree.unflatten(self.treedef, out)
+
+    def segment_ids(self) -> jnp.ndarray:
+        """Flat-index → leaf-id map; padding gets the extra id
+        ``num_leaves`` so it never contaminates a real parameter."""
+        ids = jnp.concatenate(
+            [
+                jnp.full((s,), i, jnp.int32)
+                for i, s in enumerate(self.sizes)
+            ]
+        )
+        return jnp.pad(
+            ids, (0, self.padded - self.total),
+            constant_values=self.num_leaves,
+        )
+
+
+class _DistributedOptimizer:
+    """Shared reduce-scatter → sharded step → all-gather skeleton."""
+
+    def __init__(self, lr: float, axis_name: str = DATA_PARALLEL_AXIS):
+        self.lr = lr
+        self.axis_name = axis_name
+
+    # subclass hook: update on the local 1-D fp32 shard
+    def _update_shard(
+        self, extra: dict, step, g, p, lr, meta: _FlatMeta, ids_local
+    ) -> Tuple[jnp.ndarray, dict]:
+        raise NotImplementedError
+
+    def _extra_init(self, shard_size: int) -> dict:
+        return {
+            "exp_avg": jnp.zeros((shard_size,), jnp.float32),
+            "exp_avg_sq": jnp.zeros((shard_size,), jnp.float32),
+        }
+
+    def state_specs(self) -> dict:
+        specs = {k: P(self.axis_name) for k in self._extra_init(1)}
+        specs["step"] = P()
+        specs["master"] = P(self.axis_name)
+        return specs
+
+    def init(self, params: Any) -> dict:
+        """Build the sharded state — call inside shard_map with
+        replicated params; each rank keeps only its flat shard."""
+        world = lax.axis_size(self.axis_name)
+        rank = lax.axis_index(self.axis_name)
+        meta = _FlatMeta(params, world)
+        flat = meta.flatten(params)
+        local = lax.dynamic_slice(flat, (rank * meta.shard,), (meta.shard,))
+        state = {"step": jnp.int32(0), "master": local}
+        state.update(self._extra_init(meta.shard))
+        return state
+
+    def step(
+        self,
+        state: dict,
+        grads: Any,
+        params: Any,
+        lr: Optional[jnp.ndarray] = None,
+        grads_finite: Optional[jnp.ndarray] = None,
+    ) -> Tuple[Any, dict]:
+        """reduce-scatter grads → sharded update → all-gather params.
+
+        ``grads`` are the raw per-rank gradients — do NOT pre-psum them
+        over dp; the reduce-scatter here replaces that all-reduce
+        (reference: distributed_fused_adam.py overlapped RS+AR).
+        Returns (new_params in model dtype, new_state).
+        """
+        world = lax.axis_size(self.axis_name)
+        rank = lax.axis_index(self.axis_name)
+        meta = _FlatMeta(params, world)
+        lr = f32(self.lr if lr is None else lr)
+
+        flat_grads = meta.flatten(grads)
+        # mean-reduce-scatter: each rank receives its shard of the
+        # dp-summed gradient
+        g_local = (
+            lax.psum_scatter(flat_grads, self.axis_name, tiled=True) / world
+        )
+        ids = meta.segment_ids()
+        ids_local = lax.dynamic_slice(
+            ids, (rank * meta.shard,), (meta.shard,)
+        )
+
+        new_step = state["step"] + 1
+        extra = {
+            k: v for k, v in state.items() if k not in ("step", "master")
+        }
+        new_master, new_extra = self._update_shard(
+            extra, new_step, g_local, state["master"], lr, meta, ids_local
+        )
+
+        new_state = dict(new_extra)
+        new_state["step"] = new_step
+        new_state["master"] = new_master
+        if grads_finite is not None:
+            new_state = tree_where(grads_finite, new_state, state)
+            new_master = new_state["master"]
+
+        flat_params = all_gather_invariant(
+            new_master, self.axis_name, axis=0, tiled=True
+        )
+        new_params = meta.unflatten(flat_params)
+        return new_params, new_state
+
+
+class DistributedFusedAdam(_DistributedOptimizer):
+    """Sharded Adam/AdamW
+    (reference: apex/contrib/optimizers/distributed_fused_adam.py)."""
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        adam_w_mode: bool = True,
+        weight_decay: float = 0.0,
+        axis_name: str = DATA_PARALLEL_AXIS,
+    ):
+        super().__init__(lr=lr, axis_name=axis_name)
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+
+    def _update_shard(self, extra, step, g, p, lr, meta, ids_local):
+        b1, b2 = f32(self.beta1), f32(self.beta2)
+        stepf = step.astype(jnp.float32)
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** stepf
+            bc2 = 1.0 - b2 ** stepf
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        wd = f32(self.weight_decay)
+        if not self.adam_w_mode and self.weight_decay != 0.0:
+            g = g + wd * p
+        m = b1 * extra["exp_avg"] + (1.0 - b1) * g
+        v = b2 * extra["exp_avg_sq"] + (1.0 - b2) * jnp.square(g)
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+        if self.adam_w_mode and self.weight_decay != 0.0:
+            update = update + wd * p
+        return p - lr * update, {"exp_avg": m, "exp_avg_sq": v}
+
+
+class DistributedFusedLAMB(_DistributedOptimizer):
+    """Sharded LAMB with exact per-parameter trust ratios
+    (reference: apex/contrib/optimizers/distributed_fused_lamb.py:10-910;
+    step at :836).  Per-parameter norms are assembled from shard-local
+    segment sums + a psum, so sharding does not change the math."""
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        adam_w_mode: bool = True,
+        grad_averaging: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        axis_name: str = DATA_PARALLEL_AXIS,
+    ):
+        super().__init__(lr=lr, axis_name=axis_name)
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+
+    def _segment_norms(self, x, ids_local, meta):
+        """Global per-parameter L2 norms of a sharded flat vector."""
+        partial = jax.ops.segment_sum(
+            jnp.square(x), ids_local, num_segments=meta.num_leaves + 1
+        )
+        return jnp.sqrt(lax.psum(partial, self.axis_name))
+
+    def _update_shard(self, extra, step, g, p, lr, meta, ids_local):
+        b1, b2 = f32(self.beta1), f32(self.beta2)
+        beta3 = 1.0 - b1 if self.grad_averaging else jnp.float32(1.0)
+        stepf = step.astype(jnp.float32)
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** stepf
+            bc2 = 1.0 - b2 ** stepf
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        wd = f32(self.weight_decay)
+
+        # global grad-norm clip (clip-after-reduce, the reference's
+        # `_clip_after_ar` default path)
+        gnorm = jnp.sqrt(
+            lax.psum(jnp.sum(jnp.square(g)), self.axis_name)
+        )
+        if self.max_grad_norm is not None and self.max_grad_norm > 0:
+            clip = jnp.where(
+                gnorm > self.max_grad_norm, self.max_grad_norm / gnorm, 1.0
+            )
+        else:
+            clip = jnp.float32(1.0)
+        g = g * clip
+
+        m = b1 * extra["exp_avg"] + beta3 * g
+        v = b2 * extra["exp_avg_sq"] + (1.0 - b2) * jnp.square(g)
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+        if self.weight_decay != 0.0:
+            update = update + wd * p
+
+        w_norms = self._segment_norms(p, ids_local, meta)
+        u_norms = self._segment_norms(update, ids_local, meta)
+        if self.weight_decay == 0.0 and not self.use_nvlamb:
+            trust_per_leaf = jnp.ones_like(w_norms)
+        else:
+            trust_per_leaf = jnp.where(
+                (w_norms > 0) & (u_norms > 0),
+                w_norms / jnp.maximum(u_norms, 1e-30),
+                1.0,
+            )
+        trust = trust_per_leaf[ids_local]
+        return p - lr * trust * update, {"exp_avg": m, "exp_avg_sq": v}
