@@ -1,0 +1,72 @@
+"""FMHA: fused multi-head attention with a varlen (cu_seqlens) API.
+
+Capability match of ``apex.contrib.fmha``
+(reference: apex/contrib/fmha/fmha.py:33-80, sm80-only kernels for
+seqlen ∈ {128,256,384,512} in apex/contrib/csrc/fmha/).  The TPU flash
+attention kernel has no sequence-length table, so this wrapper only adds
+the reference's packed-varlen calling convention: qkv packed as
+(total_tokens, 3, heads, head_dim) plus ``cu_seqlens`` prefix offsets.
+
+Varlen is realized the XLA-friendly way: segment-id masking inside one
+padded batch (dynamic shapes would defeat jit), which is how TPU
+production stacks express varlen attention.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import flash_attention, mha_reference
+
+__all__ = ["fmha", "FMHA"]
+
+
+def fmha(
+    qkv: jnp.ndarray,
+    cu_seqlens: jnp.ndarray,
+    max_seq_len: int,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Packed-varlen attention (reference: ``FMHAFun.apply``).
+
+    ``qkv``: (total_tokens, 3, heads, head_dim); ``cu_seqlens``: (B+1,)
+    int32 prefix sums.  Returns (total_tokens, heads, head_dim).
+    """
+    total, three, heads, d = qkv.shape
+    assert three == 3
+    b = cu_seqlens.shape[0] - 1
+
+    # scatter packed tokens into a (b, max_seq_len) padded batch
+    tok = jnp.arange(total)
+    seg = jnp.searchsorted(cu_seqlens[1:], tok, side="right")  # (total,)
+    pos = tok - cu_seqlens[seg]
+    batch_idx = seg * max_seq_len + pos
+    padded = jnp.zeros((b * max_seq_len, 3, heads, d), qkv.dtype)
+    padded = padded.at[batch_idx].set(qkv)
+    padded = padded.reshape(b, max_seq_len, 3, heads, d)
+
+    q, k, v = (
+        jnp.moveaxis(padded[:, :, i], 2, 1) for i in range(3)
+    )  # (b, heads, s, d)
+    lengths = cu_seqlens[1:] - cu_seqlens[:-1]  # (b,)
+    key_pos = jnp.arange(max_seq_len)
+    # additive mask: padded keys contribute -inf
+    bias = jnp.where(
+        key_pos[None, :] < lengths[:, None], 0.0, -1e30
+    )[:, None, None, :]  # (b, 1, 1, s)
+    out = mha_reference(q, k, v, causal=causal, bias=bias)
+    out = jnp.moveaxis(out, 1, 2).reshape(b * max_seq_len, heads, d)
+    return out[batch_idx]
+
+
+class FMHA:
+    """Module wrapper (reference: apex/contrib/fmha/fmha.py ``FMHA``)."""
+
+    def __init__(self, causal: bool = False):
+        self.causal = causal
+
+    def __call__(self, qkv, cu_seqlens, max_s):
+        return fmha(qkv, cu_seqlens, max_s, causal=self.causal)

@@ -1,0 +1,16 @@
+"""FastLayerNorm (reference: apex/contrib/layer_norm/layer_norm.py:40-55,
+template-specialized one-pass kernels in apex/contrib/csrc/layer_norm/).
+
+On TPU the "fast" and the standard fused layernorm are the same Pallas
+kernel — there is no hidden-size template table to outgrow — so this
+module re-exports the normalization stack under the contrib name for API
+parity.  The reference's hidden-size restriction (supported sizes only)
+does not apply.
+"""
+
+from apex_tpu.normalization import FusedLayerNorm as FastLayerNorm
+from apex_tpu.ops.layer_norm import (
+    fused_layer_norm_affine as fast_layer_norm,
+)
+
+__all__ = ["FastLayerNorm", "fast_layer_norm"]

@@ -1,0 +1,115 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+Capability match of ``apex.contrib.sparsity``
+(reference: apex/contrib/sparsity/asp.py:21-217, mask calculators in
+sparse_masklib.py:1-184).  The reference keeps mask buffers on every
+eligible module and monkey-patches ``optimizer.step`` to re-apply them;
+the TPU-native design is functional: masks are a pytree computed from
+params, applied with a tree-map, and optimizer integration is a wrapper
+that re-masks after each step — no in-place mutation, jit-fusable into
+the train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["create_mask", "ASP"]
+
+
+def _m4n2_1d(w2d: jnp.ndarray) -> jnp.ndarray:
+    """Keep the 2 largest-|w| of every contiguous group of 4 along the
+    last dim (reference: sparse_masklib.py ``mn_1d_best``/``m4n2_1d``)."""
+    rows, cols = w2d.shape
+    if cols % 4:
+        raise ValueError(
+            f"2:4 sparsity needs a multiple-of-4 inner dim, got {cols}"
+        )
+    g = jnp.abs(w2d).reshape(rows, cols // 4, 4)
+    # rank within each group; keep the top 2
+    order = jnp.argsort(g, axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    mask = ranks >= 2
+    return mask.reshape(rows, cols)
+
+
+_PATTERNS = {"m4n2_1d": _m4n2_1d}
+
+
+def create_mask(w: jnp.ndarray, pattern: str = "m4n2_1d") -> jnp.ndarray:
+    """Boolean keep-mask with the requested structured pattern
+    (reference: sparse_masklib.create_mask)."""
+    if pattern not in _PATTERNS:
+        raise ValueError(f"unknown sparsity pattern {pattern!r}")
+    shape = w.shape
+    w2d = w.reshape(-1, shape[-1])
+    return _PATTERNS[pattern](w2d).reshape(shape)
+
+
+def _default_eligible(path: tuple, leaf: Any) -> bool:
+    """The reference prunes Linear/Conv weights with both dims ≥ some
+    minimum and divisible by 4 (asp.py ``eligible``); here: ≥2-D leaves
+    whose last dim divides by 4 and whose name isn't bias/norm-like."""
+    if getattr(leaf, "ndim", 0) < 2 or leaf.shape[-1] % 4:
+        return False
+    name = str(path[-1]).lower() if path else ""
+    return not any(t in name for t in ("bias", "scale", "norm", "embed"))
+
+
+class ASP:
+    """Functional ASP (reference: apex/contrib/sparsity/asp.py ``ASP``).
+
+    Usage::
+
+        asp = ASP()                       # whitelist by predicate
+        masks = asp.compute_sparse_masks(params)
+        params = asp.apply_masks(params, masks)   # prune_trained_model
+        step = asp.wrap_optimizer_step(opt.step, masks)  # re-mask updates
+    """
+
+    def __init__(
+        self,
+        mask_calculator: str = "m4n2_1d",
+        eligible: Optional[Callable[[tuple, Any], bool]] = None,
+    ):
+        self.pattern = mask_calculator
+        self.eligible = eligible or _default_eligible
+
+    def compute_sparse_masks(self, params: Any) -> Any:
+        """(reference: asp.py:155-211) — all-True masks for ineligible
+        leaves so the mask pytree always matches the params."""
+
+        def mask(path, leaf):
+            if self.eligible(path, leaf):
+                return create_mask(leaf, self.pattern)
+            return jnp.ones(jnp.shape(leaf), bool)
+
+        return jax.tree_util.tree_map_with_path(mask, params)
+
+    def apply_masks(self, params: Any, masks: Any) -> Any:
+        """(reference: prune_trained_model, asp.py:212-217)"""
+        return jax.tree.map(
+            lambda p, m: jnp.where(m, p, jnp.zeros_like(p)), params, masks
+        )
+
+    def wrap_optimizer_step(self, step_fn: Callable, masks: Any) -> Callable:
+        """The functional analog of ``init_optimizer_for_pruning``'s step
+        patch (reference: asp.py:127-153): run the wrapped step, then
+        re-apply the masks to the returned params."""
+
+        def wrapped(state, grads, params, *a, **kw):
+            new_params, new_state = step_fn(state, grads, params, *a, **kw)
+            return self.apply_masks(new_params, masks), new_state
+
+        return wrapped
+
+    @staticmethod
+    def sparsity(masks: Any) -> float:
+        """Fraction of zeroed weights across all masked leaves."""
+        leaves = jax.tree.leaves(masks)
+        zeros = sum(int(jnp.size(m)) - int(jnp.sum(m)) for m in leaves)
+        total = sum(int(jnp.size(m)) for m in leaves)
+        return zeros / max(total, 1)

@@ -1,0 +1,139 @@
+"""Transducer (RNN-T) joint and loss.
+
+Capability match of ``apex.contrib.transducer``
+(reference: apex/contrib/transducer/transducer.py — ``TransducerJoint``
+:5, ``TransducerLoss`` :68; kernels in apex/contrib/csrc/transducer/).
+
+- The joint's broadcast add ``f[:,t] + g[:,u]`` fuses under XLA; the
+  reference's packed-input path (dropping pad positions to save memory)
+  is replaced by masking — dynamic shapes would defeat jit, and padded
+  lanes are free on the VPU.
+- The loss is the exact RNN-T forward algorithm (alpha recursion in log
+  space) written with ``lax.scan`` over time; its backward comes from
+  autodiff of the recursion, which reproduces the fused
+  softmax-gradient trick's math (the reference fuses d(loss)/d(logits)
+  with the softmax backward to save one V-sized tensor).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["TransducerJoint", "TransducerLoss", "transducer_loss"]
+
+_NEG = -1e30
+
+
+class TransducerJoint:
+    """h[b,t,u] = f[b,t] + g[b,u] (+ relu, + dropout)
+    (reference: transducer.py:5-66 ``TransducerJoint``)."""
+
+    def __init__(self, pack_output: bool = False, relu: bool = False,
+                 dropout: float = 0.0):
+        if pack_output:
+            raise NotImplementedError(
+                "packed output is a CUDA memory optimization; on TPU use "
+                "the dense (masked) layout"
+            )
+        self.relu = relu
+        self.dropout = dropout
+
+    def __call__(self, f: jnp.ndarray, g: jnp.ndarray,
+                 f_len: Optional[jnp.ndarray] = None,
+                 g_len: Optional[jnp.ndarray] = None,
+                 rng: Optional[jax.Array] = None) -> jnp.ndarray:
+        h = f[:, :, None, :] + g[:, None, :, :]
+        if self.relu:
+            h = jax.nn.relu(h)
+        if self.dropout > 0.0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.dropout, h.shape)
+            h = jnp.where(keep, h / (1.0 - self.dropout), 0.0)
+        return h
+
+
+def transducer_loss(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    f_len: jnp.ndarray,
+    y_len: jnp.ndarray,
+    blank_idx: int = 0,
+) -> jnp.ndarray:
+    """RNN-T negative log-likelihood per example.
+
+    ``logits``: (B, T, U+1, V); ``targets``: (B, U) label ids;
+    ``f_len``: (B,) valid time steps; ``y_len``: (B,) valid labels.
+    (reference: transducer.py:68-195 ``TransducerLoss``)
+    """
+    b, t_max, u1, v = logits.shape
+    u_max = u1 - 1
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    # blank/emit probabilities per lattice node
+    blank = logp[..., blank_idx]  # (B, T, U+1)
+    emit = jnp.take_along_axis(
+        logp[:, :, :u_max, :],
+        targets[:, None, :, None].astype(jnp.int32),
+        axis=-1,
+    )[..., 0]  # (B, T, U)
+    # mask invalid label positions
+    upos = jnp.arange(u_max)
+    emit = jnp.where(upos[None, None, :] < y_len[:, None, None], emit, _NEG)
+
+    # alpha over the (T, U+1) lattice: alpha[t,u] =
+    #   logaddexp(alpha[t-1,u] + blank[t-1,u], alpha[t,u-1] + emit[t,u-1]);
+    # the within-row (label) recursion a[u] = logaddexp(h[u], a[u-1]+m[u])
+    # is a log-space linear recurrence solved with an associative scan,
+    # so each time row costs O(log U) depth instead of a U-length loop.
+
+    def combine(x, y):
+        # elements are affine maps a → logaddexp(add, a + mul)
+        xa, xm = x
+        ya, ym = y
+        return jnp.logaddexp(ya, xa + ym), xm + ym
+
+    def row_update(horiz, emit_row):
+        mul = jnp.concatenate(
+            [jnp.zeros((b, 1)), emit_row], axis=1
+        )  # mul[0] unused: u=0 has no left neighbour
+        out, _ = lax.associative_scan(combine, (horiz, mul), axis=1)
+        return out
+
+    alpha0 = jnp.full((b, u1), _NEG).at[:, 0].set(0.0)
+    alpha = row_update(alpha0, emit[:, 0, :])  # row t=0: vertical only
+
+    def time_step(alpha, x):
+        blank_t, emit_t = x  # blank of row t-1, emit of row t
+        new_alpha = row_update(alpha + blank_t, emit_t)
+        return new_alpha, new_alpha
+
+    xs = (jnp.moveaxis(blank, 1, 0)[:-1], jnp.moveaxis(emit, 1, 0)[1:])
+    _, rows = lax.scan(time_step, alpha, xs)
+    all_alphas = jnp.concatenate([alpha[None], rows], axis=0)  # (T, B, U+1)
+
+    # ll = alpha[f_len-1, y_len] + blank[f_len-1, y_len]
+    t_idx = jnp.clip(f_len - 1, 0, t_max - 1)
+    a_at = all_alphas[t_idx, jnp.arange(b), :]  # (B, U+1)
+    a_fin = jnp.take_along_axis(a_at, y_len[:, None], axis=1)[:, 0]
+    bl_at = blank[jnp.arange(b), t_idx, :]
+    bl_fin = jnp.take_along_axis(bl_at, y_len[:, None], axis=1)[:, 0]
+    return -(a_fin + bl_fin)
+
+
+class TransducerLoss:
+    """Module wrapper (reference: transducer.py:68 ``TransducerLoss``)."""
+
+    def __init__(self, fuse_softmax_backward: bool = True,
+                 packed_input: bool = False):
+        if packed_input:
+            raise NotImplementedError(
+                "packed input is a CUDA memory optimization; use the dense "
+                "(masked) layout on TPU"
+            )
+        # fuse_softmax_backward is implicit: autodiff of log_softmax
+        # produces the fused form
+
+    def __call__(self, x, label, f_len, y_len, blank_idx: int = 0):
+        return transducer_loss(x, label, f_len, y_len, blank_idx)

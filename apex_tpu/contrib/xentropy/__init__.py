@@ -1,0 +1,92 @@
+"""Fused softmax cross-entropy with label smoothing.
+
+Capability match of ``apex.contrib.xentropy.SoftmaxCrossEntropyLoss``
+(reference: apex/contrib/xentropy/softmax_xentropy.py:4-28, kernels in
+apex/contrib/csrc/xentropy/).  The reference fuses softmax+CE and does
+an in-place bprop to save memory; under XLA the fused fwd/bwd falls out
+of one jitted expression (log-sum-exp never materializes the softmax),
+and a custom vjp keeps the backward to the same softmax-minus-delta form
+the kernel uses.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["softmax_cross_entropy_loss", "SoftmaxCrossEntropyLoss"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_cross_entropy_loss(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    smoothing: float = 0.0,
+    half_to_float: bool = False,
+):
+    """Per-example smoothed CE. ``logits`` (..., V), ``labels`` (...).
+
+    loss = (1-s)·nll(target) + s·mean-over-vocab nll
+    (reference kernel semantics: label_smoothing spreads s uniformly).
+    """
+    loss, _ = _fwd_math(logits, labels, smoothing, half_to_float)
+    return loss
+
+
+def _fwd_math(logits, labels, smoothing, half_to_float):
+    x = logits.astype(jnp.float32)
+    m = jax.lax.stop_gradient(jnp.max(x, axis=-1, keepdims=True))
+    shifted = x - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
+    target_logit = jnp.take_along_axis(x, labels[..., None], axis=-1)[..., 0]
+    nll = lse - target_logit
+    if smoothing > 0.0:
+        mean_logit = jnp.mean(x, axis=-1)
+        smooth_nll = lse - mean_logit
+        loss = (1.0 - smoothing) * nll + smoothing * smooth_nll
+    else:
+        loss = nll
+    if not half_to_float:
+        loss = loss.astype(logits.dtype)
+    return loss, (logits, labels)
+
+
+def _fwd(logits, labels, smoothing, half_to_float):
+    return _fwd_math(logits, labels, smoothing, half_to_float)
+
+
+def _bwd(smoothing, half_to_float, res, g):
+    logits, labels = res
+    x = logits.astype(jnp.float32)
+    p = jax.nn.softmax(x, axis=-1)
+    v = x.shape[-1]
+    onehot = jax.nn.one_hot(labels, v, dtype=jnp.float32)
+    # d loss/d logits = softmax - (1-s)*onehot - s/V   (kernel bprop form)
+    dx = p - (1.0 - smoothing) * onehot - smoothing / v
+    dx = dx * g[..., None].astype(jnp.float32)
+    return dx.astype(logits.dtype), None
+
+
+softmax_cross_entropy_loss.defvjp(_fwd, _bwd)
+
+
+class SoftmaxCrossEntropyLoss:
+    """Module-style wrapper (reference: ``SoftmaxCrossEntropyLoss.apply``
+    signature: logits, labels, smoothing, padding_idx, half_to_float)."""
+
+    def __init__(self, smoothing: float = 0.0, padding_idx: int = 0,
+                 half_to_float: bool = False):
+        self.smoothing = smoothing
+        self.padding_idx = padding_idx
+        self.half_to_float = half_to_float
+
+    def __call__(self, logits: jnp.ndarray, labels: jnp.ndarray):
+        losses = softmax_cross_entropy_loss(
+            logits, labels, self.smoothing, self.half_to_float
+        )
+        if self.padding_idx is not None:
+            losses = jnp.where(labels == self.padding_idx,
+                               jnp.zeros_like(losses), losses)
+        return losses

@@ -1,0 +1,129 @@
+"""Fused dense layers: GEMM + bias (+ GELU) epilogues.
+
+Capability match of ``apex.fused_dense``
+(reference: apex/fused_dense/fused_dense.py:6-86, backed by cublasLt
+epilogue kernels in csrc/fused_dense_cuda.cu).  On TPU the epilogue
+fusion is XLA's job: a jitted matmul+bias+gelu chain compiles to one MXU
+pass with the elementwise tail fused into the output copy, so these are
+thin functional modules — the *capability* (no extra HBM round-trip for
+bias/GELU) is preserved by construction, verified in the perf suite
+rather than by hand-written kernels.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "fused_dense_function",
+    "fused_dense_gelu_dense_function",
+    "FusedDense",
+    "FusedDenseGeluDense",
+]
+
+
+def fused_dense_function(
+    x: jnp.ndarray, weight: jnp.ndarray, bias: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """y = x @ W + b  (reference: fused_dense.py ``fused_dense_function``).
+
+    ``weight`` is (in, out) — MXU-friendly row-major layout.
+    """
+    y = jnp.matmul(x, weight.astype(x.dtype))
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+def fused_dense_gelu_dense_function(
+    x: jnp.ndarray,
+    weight1: jnp.ndarray,
+    bias1: jnp.ndarray,
+    weight2: jnp.ndarray,
+    bias2: jnp.ndarray,
+) -> jnp.ndarray:
+    """y = gelu(x @ W1 + b1) @ W2 + b2 (reference:
+    ``fused_dense_gelu_dense_function``, the cublasLt GELU-epilogue
+    pair).  tanh-approximate GELU matches the reference kernel."""
+    h = jax.nn.gelu(
+        fused_dense_function(x, weight1, bias1), approximate=True
+    )
+    return fused_dense_function(h, weight2, bias2)
+
+
+class _DenseInit:
+    @staticmethod
+    def _init_wb(key, fan_in, shape_w, shape_b, dtype):
+        kw, kb = jax.random.split(key)
+        bound = 1.0 / math.sqrt(fan_in)
+        w = jax.random.uniform(kw, shape_w, dtype, -bound, bound)
+        b = jax.random.uniform(kb, shape_b, dtype, -bound, bound)
+        return w, b
+
+
+class FusedDense(_DenseInit):
+    """Linear + bias module (reference: fused_dense.py ``FusedDense``)."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 params_dtype: Any = jnp.float32):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.params_dtype = params_dtype
+
+    def init(self, key) -> dict:
+        w, b = self._init_wb(
+            key, self.in_features, (self.in_features, self.out_features),
+            (self.out_features,), self.params_dtype,
+        )
+        params = {"weight": w}
+        if self.use_bias:
+            params["bias"] = b
+        return params
+
+    def apply(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        return fused_dense_function(
+            x, params["weight"], params.get("bias")
+        )
+
+
+class FusedDenseGeluDense(_DenseInit):
+    """Linear+GELU+Linear module (reference: fused_dense.py
+    ``FusedDenseGeluDense``)."""
+
+    def __init__(self, in_features: int, intermediate_features: int,
+                 out_features: int, bias: bool = True,
+                 params_dtype: Any = jnp.float32):
+        if not bias:
+            raise RuntimeError(
+                "FusedDenseGeluDense module without bias is currently not "
+                "supported"  # same restriction as the reference (:81)
+            )
+        self.in_features = in_features
+        self.intermediate_features = intermediate_features
+        self.out_features = out_features
+        self.params_dtype = params_dtype
+
+    def init(self, key) -> dict:
+        k1, k2 = jax.random.split(key)
+        w1, b1 = self._init_wb(
+            k1, self.in_features,
+            (self.in_features, self.intermediate_features),
+            (self.intermediate_features,), self.params_dtype,
+        )
+        w2, b2 = self._init_wb(
+            k2, self.intermediate_features,
+            (self.intermediate_features, self.out_features),
+            (self.out_features,), self.params_dtype,
+        )
+        return {"weight1": w1, "bias1": b1, "weight2": w2, "bias2": b2}
+
+    def apply(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        return fused_dense_gelu_dense_function(
+            x, params["weight1"], params["bias1"],
+            params["weight2"], params["bias2"],
+        )

@@ -1,0 +1,86 @@
+"""Whole-MLP fused module.
+
+Capability match of ``apex.mlp`` (reference: apex/mlp/mlp.py:8-80, one
+C++ call per fwd/bwd over N layers in csrc/mlp_cuda.cu).  Under jit the
+whole stack compiles into one fused program, so the TPU design point is a
+plain scan-free loop over layers; the reference's single-launch property
+(no per-layer python overhead at runtime) holds for any depth.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MLP", "mlp_function"]
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def mlp_function(params: Sequence[dict], x: jnp.ndarray,
+                 activation: str = "relu") -> jnp.ndarray:
+    """Forward through the whole MLP (reference: ``mlp_function``, which
+    apex registers as an amp half_function — here the caller's precision
+    policy decides the compute dtype)."""
+    act = _ACTIVATIONS[activation]
+    h = x
+    last = len(params) - 1
+    for i, layer in enumerate(params):
+        h = jnp.matmul(h, layer["weight"].astype(h.dtype))
+        if "bias" in layer:
+            h = h + layer["bias"].astype(h.dtype)
+        if i != last:  # reference applies activation between layers only
+            h = act(h)
+    return h
+
+
+class MLP:
+    """Launch N linear(+bias, +relu/sigmoid) layers as one fused program
+    (reference: apex/mlp/mlp.py ``MLP``; sizes = [in, h1, ..., out])."""
+
+    def __init__(self, mlp_sizes: Sequence[int], bias: bool = True,
+                 activation: str = "relu", params_dtype: Any = jnp.float32):
+        if len(mlp_sizes) < 2:
+            raise TypeError(
+                f"MLP requires at least two sizes (in, out); got {mlp_sizes}"
+            )
+        if activation not in _ACTIVATIONS:
+            raise TypeError(f"Activation type {activation} is not supported")
+        self.mlp_sizes = list(mlp_sizes)
+        self.use_bias = bias
+        self.activation = activation
+        self.params_dtype = params_dtype
+
+    def init(self, key) -> list:
+        params = []
+        keys = jax.random.split(key, len(self.mlp_sizes) - 1)
+        for k, fan_in, fan_out in zip(
+            keys, self.mlp_sizes[:-1], self.mlp_sizes[1:]
+        ):
+            kw, kb = jax.random.split(k)
+            # reference reset_parameters: kaiming uniform on weights,
+            # uniform(-1/sqrt(fan_in)) on bias (mlp.py:49-56)
+            bound_w = math.sqrt(3.0 / fan_in)
+            layer = {
+                "weight": jax.random.uniform(
+                    kw, (fan_in, fan_out), self.params_dtype,
+                    -bound_w, bound_w,
+                )
+            }
+            if self.use_bias:
+                bound_b = 1.0 / math.sqrt(fan_in)
+                layer["bias"] = jax.random.uniform(
+                    kb, (fan_out,), self.params_dtype, -bound_b, bound_b
+                )
+            params.append(layer)
+        return params
+
+    def apply(self, params: list, x: jnp.ndarray) -> jnp.ndarray:
+        return mlp_function(params, x, self.activation)
