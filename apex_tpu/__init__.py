@@ -72,6 +72,27 @@ from apex_tpu import multi_tensor_apply  # noqa: E402
 from apex_tpu import optimizers  # noqa: E402
 from apex_tpu import normalization  # noqa: E402
 from apex_tpu import parallel  # noqa: E402
+from apex_tpu import fused_dense  # noqa: E402
+from apex_tpu import mlp  # noqa: E402
+from apex_tpu import fp16_utils  # noqa: E402
+from apex_tpu import rnn  # noqa: E402
+from apex_tpu import reparameterization  # noqa: E402
+
+# heavier subpackages load lazily: `apex_tpu.transformer`,
+# `apex_tpu.models`, `apex_tpu.contrib`, `apex_tpu.ops` resolve on first
+# attribute access
+_LAZY = ("transformer", "models", "contrib", "ops")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f"apex_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")
+
 
 __all__ = [
     "amp",
@@ -79,6 +100,15 @@ __all__ = [
     "optimizers",
     "normalization",
     "parallel",
+    "fused_dense",
+    "mlp",
+    "fp16_utils",
+    "rnn",
+    "reparameterization",
+    "transformer",
+    "models",
+    "contrib",
+    "ops",
     "logger",
     "__version__",
 ]

@@ -1,0 +1,93 @@
+"""Group BatchNorm, NHWC, with fused add+ReLU.
+
+Capability match of ``apex.contrib.groupbn``
+(reference: apex/contrib/groupbn/batch_norm.py:116-234
+``BatchNorm2d_NHWC``, raw-IPC peer buffers in apex/contrib/csrc/groupbn/).
+NHWC is the native TPU layout, and the "BN group" peer-to-peer stats
+exchange maps to a group-limited psum over the dp axis — the machinery
+already in :func:`apex_tpu.parallel.sync_batch_norm` (its
+``process_group_size`` argument is exactly ``bn_group``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
+from apex_tpu.transformer.parallel_state import DATA_PARALLEL_AXIS
+
+__all__ = ["BatchNorm2d_NHWC", "batch_norm_nhwc"]
+
+
+def batch_norm_nhwc(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    bias: jnp.ndarray,
+    running_mean: Optional[jnp.ndarray] = None,
+    running_var: Optional[jnp.ndarray] = None,
+    *,
+    z: Optional[jnp.ndarray] = None,
+    training: bool = True,
+    momentum: float = 0.1,
+    eps: float = 1e-5,
+    bn_group: int = 1,
+    axis_name: Optional[str] = DATA_PARALLEL_AXIS,
+    fuse_relu: bool = False,
+):
+    """NHWC batchnorm with optional fused residual-add (+ReLU)
+    (reference: ``batch_norm_add_relu``).  ``z`` is the residual."""
+    out, rm, rv = sync_batch_norm(
+        x, weight, bias, running_mean, running_var,
+        training=training, momentum=momentum, eps=eps,
+        axis_name=axis_name if bn_group != 1 else None,
+        process_group_size=0 if bn_group in (0, 1) else bn_group,
+        fuse_relu=False,
+    )
+    if z is not None:
+        out = out + z.astype(out.dtype)
+    if fuse_relu:
+        out = jax.nn.relu(out)
+    return out, rm, rv
+
+
+class BatchNorm2d_NHWC:
+    """Module form (reference: batch_norm.py:116-234): channels-last BN
+    whose stats are shared among groups of ``bn_group`` dp ranks."""
+
+    def __init__(self, num_features: int, fuse_relu: bool = False,
+                 bn_group: int = 1, momentum: float = 0.1, eps: float = 1e-5,
+                 params_dtype: Any = jnp.float32,
+                 axis_name: str = DATA_PARALLEL_AXIS):
+        self.num_features = num_features
+        self.fuse_relu = fuse_relu
+        self.bn_group = bn_group
+        self.momentum = momentum
+        self.eps = eps
+        self.params_dtype = params_dtype
+        self.axis_name = axis_name
+
+    def init(self, key=None) -> dict:
+        f = self.num_features
+        return {
+            "weight": jnp.ones((f,), self.params_dtype),
+            "bias": jnp.zeros((f,), self.params_dtype),
+            "running_mean": jnp.zeros((f,), jnp.float32),
+            "running_var": jnp.ones((f,), jnp.float32),
+        }
+
+    def apply(self, params: dict, x: jnp.ndarray,
+              z: Optional[jnp.ndarray] = None, training: bool = True):
+        """Returns (out, new_params) — running stats are values, not
+        buffers, in the functional style."""
+        out, rm, rv = batch_norm_nhwc(
+            x, params["weight"], params["bias"],
+            params["running_mean"], params["running_var"],
+            z=z, training=training, momentum=self.momentum, eps=self.eps,
+            bn_group=self.bn_group, axis_name=self.axis_name,
+            fuse_relu=self.fuse_relu,
+        )
+        new_params = dict(params, running_mean=rm, running_var=rv)
+        return out, new_params

@@ -1,0 +1,166 @@
+"""Legacy manual mixed-precision utilities.
+
+Capability match of ``apex.fp16_utils``
+(reference: apex/fp16_utils/fp16_optimizer.py:13-554, fp16util.py:7-187,
+loss_scaler.py:10-186): the pre-amp manual workflow — cast the network,
+keep fp32 masters, scale the loss, unscale/clip grads, skip on overflow.
+Functional equivalents:
+
+- :func:`network_to_half` / :func:`convert_network` — pytree casts (BN
+  params kept fp32 by predicate, like the reference's module walk)
+- :func:`prep_param_lists` — (model_params, master_params) pair
+- :func:`model_grads_to_master_grads` / :func:`master_params_to_model_params`
+- :class:`FP16_Optimizer` — wraps any fused optimizer with a loss scaler
+  and master weights, same method surface (``scale_loss``, ``step``,
+  ``state_dict``), but pure state in/out instead of in-place mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import is_norm_param
+from apex_tpu.amp.scaler import LossScaler, ScalerState, all_finite
+from apex_tpu.optimizers.base import FusedOptimizer, tree_where
+
+__all__ = [
+    "network_to_half",
+    "convert_network",
+    "prep_param_lists",
+    "model_grads_to_master_grads",
+    "master_params_to_model_params",
+    "FP16_Optimizer",
+]
+
+
+def network_to_half(params: Any, dtype=jnp.float16) -> Any:
+    """Cast every float leaf (reference: fp16util.py:7 ``network_to_half``
+    via the tofp16 module wrapper)."""
+    return jax.tree.map(
+        lambda p: p.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else p,
+        params,
+    )
+
+
+def convert_network(params: Any, dtype=jnp.float16,
+                    keep_fp32: Callable = is_norm_param) -> Any:
+    """Cast float leaves except batchnorm/layernorm-ish params
+    (reference: fp16util.py:60 ``convert_network`` keeps BN fp32)."""
+
+    def cast(path, p):
+        if not jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating):
+            return p
+        if keep_fp32(path, p):
+            return p
+        return p.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def prep_param_lists(params: Any) -> Tuple[Any, Any]:
+    """(model_params, fp32 master copy)
+    (reference: fp16util.py:90 ``prep_param_lists``)."""
+    master = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params)
+    return params, master
+
+
+def model_grads_to_master_grads(model_grads: Any) -> Any:
+    """(reference: fp16util.py:136)"""
+    return jax.tree.map(lambda g: g.astype(jnp.float32), model_grads)
+
+
+def master_params_to_model_params(model_params: Any, master: Any) -> Any:
+    """(reference: fp16util.py:158)"""
+    return jax.tree.map(
+        lambda p, m: m.astype(jnp.asarray(p).dtype), model_params, master
+    )
+
+
+class FP16_Optimizer:
+    """Manual master-weight optimizer wrapper
+    (reference: apex/fp16_utils/fp16_optimizer.py:13-554).
+
+    Pure-state usage::
+
+        opt = FP16_Optimizer(FusedAdam(lr=1e-3), dynamic_loss_scale=True)
+        state = opt.init(model_params)           # masters + scaler state
+        scaled = opt.scale_loss(state, loss)     # ← backward this
+        params, state = opt.step(state, grads, params)
+    """
+
+    def __init__(
+        self,
+        optimizer: FusedOptimizer,
+        static_loss_scale: float = 1.0,
+        dynamic_loss_scale: bool = False,
+        dynamic_loss_args: Optional[dict] = None,
+        verbose: bool = False,
+    ):
+        self.optimizer = optimizer
+        kw = dict(dynamic_loss_args or {})
+        self.loss_scaler = LossScaler(
+            loss_scale="dynamic" if dynamic_loss_scale else static_loss_scale,
+            **kw,
+        )
+
+    def init(self, params: Any) -> dict:
+        _, master = prep_param_lists(params)
+        return {
+            "master": master,
+            "opt": self.optimizer.init(master),
+            "scaler": self.loss_scaler.init(),
+        }
+
+    def scale_loss(self, state: dict, loss: jnp.ndarray) -> jnp.ndarray:
+        """(reference: fp16_optimizer.py ``backward``'s scaling half)"""
+        return self.loss_scaler.scale(state["scaler"], loss)
+
+    def step(
+        self, state: dict, grads: Any, params: Any,
+        lr: Optional[jnp.ndarray] = None,
+    ) -> Tuple[Any, dict]:
+        """update_master_grads + step + master→model copy, with the
+        overflow skip (reference: fp16_optimizer.py:209-340)."""
+        master_grads = model_grads_to_master_grads(grads)
+        master_grads, finite = self.loss_scaler.unscale(
+            state["scaler"], master_grads
+        )
+        new_scaler = self.loss_scaler.adjust(state["scaler"], finite)
+        new_master, new_opt = self.optimizer.step(
+            state["opt"], master_grads, state["master"], lr=lr,
+            grads_finite=finite,
+        )
+        new_params = master_params_to_model_params(params, new_master)
+        new_params = tree_where(finite, new_params, params)
+        return new_params, {
+            "master": new_master, "opt": new_opt, "scaler": new_scaler
+        }
+
+    def clip_master_grads(self, grads: Any, max_norm: float) -> Any:
+        """(reference: fp16_optimizer.py ``clip_master_grads``)"""
+        norm = jnp.sqrt(
+            sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads))
+        )
+        clip = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+        return jax.tree.map(lambda g: g * clip.astype(g.dtype), grads)
+
+    def state_dict(self, state: dict) -> dict:
+        """(reference: fp16_optimizer.py:209-271 — includes the fp32
+        masters and scaler state)"""
+        return {
+            "master": jax.device_get(state["master"]),
+            "opt": jax.device_get(state["opt"]),
+            "scaler": self.loss_scaler.state_dict(state["scaler"]),
+        }
+
+    def load_state_dict(self, d: dict) -> dict:
+        return {
+            "master": jax.tree.map(jnp.asarray, d["master"]),
+            "opt": jax.tree.map(jnp.asarray, d["opt"]),
+            "scaler": self.loss_scaler.load_state_dict(d["scaler"]),
+        }

@@ -1,0 +1,63 @@
+"""Weight-norm reparameterization.
+
+Capability match of ``apex.reparameterization``
+(reference: apex/reparameterization/reparameterization.py:4,
+weight_norm.py:22 — module hooks rewriting ``weight`` from (g, v) before
+every forward, with a fused CUDA norm kernel in csrc).  Functionally:
+``w = g * v / ||v||`` over the chosen dim, as a pair of pure converters
+on a param pytree — apply ``compute_weight`` inside the forward (jit
+fuses the norm), no hooks needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["weight_norm_init", "compute_weight", "remove_weight_norm",
+           "apply_weight_norm"]
+
+
+def _norm_except(v: jnp.ndarray, dim: int) -> jnp.ndarray:
+    """||v|| reduced over every axis except ``dim`` (reference:
+    weight_norm.py ``norm_except_dim`` semantics; dim=None → full norm)."""
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)), axis=axes,
+                            keepdims=True))
+
+
+def weight_norm_init(weight: jnp.ndarray, dim: int = 0) -> dict:
+    """Split a weight into the (g, v) parameterization."""
+    norm = _norm_except(weight, dim)
+    return {"g": norm.astype(weight.dtype), "v": weight}
+
+
+def compute_weight(wn: dict, dim: int = 0) -> jnp.ndarray:
+    """w = g * v/||v|| (reference: weight_norm.py ``compute_weight``)."""
+    v = wn["v"]
+    norm = _norm_except(v, dim)
+    w = wn["g"].astype(jnp.float32) * v.astype(jnp.float32) / jnp.maximum(
+        norm, 1e-12
+    )
+    return w.astype(v.dtype)
+
+
+def remove_weight_norm(wn: dict, dim: int = 0) -> jnp.ndarray:
+    """Collapse (g, v) back to a plain weight (reference:
+    ``remove_weight_norm``)."""
+    return compute_weight(wn, dim)
+
+
+def apply_weight_norm(params: Any, name: str = "weight", dim: int = 0) -> Any:
+    """Convert every ``name`` leaf in a param pytree to the (g, v) form
+    (the analog of recursively hooking modules, reference:
+    apply_weight_norm with module=None)."""
+
+    def convert(path, leaf):
+        if path and str(getattr(path[-1], "key", path[-1])) == name:
+            return weight_norm_init(leaf, dim)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(convert, params)

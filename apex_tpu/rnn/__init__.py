@@ -1,0 +1,228 @@
+"""RNN stack: LSTM / GRU / vanilla RNN / mLSTM cells, stacked and
+bidirectional containers.
+
+Capability match of ``apex.RNN``
+(reference: apex/RNN/models.py:8-53, RNNBackend.py:25-232, cells.py:12-55
+— a pure-PyTorch per-timestep loop).  TPU-native redesign: each cell is a
+pure ``(params, carry, x) -> (carry, y)`` function driven by ``lax.scan``
+— one compiled loop body regardless of sequence length, instead of a
+Python loop of module calls.  The forget-gate-bias init trick
+(reference: RNNBackend.py ``init_hidden``/bias fill) is kept.
+
+Layout: (seq, batch, hidden) like the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM", "RNNCell", "StackedRNN"]
+
+
+def _uniform(key, shape, dtype, fan):
+    bound = 1.0 / math.sqrt(fan)
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+class RNNCell:
+    """One recurrent cell (reference: RNNBackend.py ``RNNCell``): gates =
+    x @ Wx + h @ Wh + b, split into ``gate_multiplier`` chunks."""
+
+    gate_multiplier = 1
+    n_hidden_states = 1  # h (LSTM adds c)
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 bias: bool = True, forget_bias: float = 1.0,
+                 params_dtype: Any = jnp.float32):
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.use_bias = bias
+        self.forget_bias = forget_bias
+        self.params_dtype = params_dtype
+
+    def init(self, key) -> Dict[str, jnp.ndarray]:
+        g, h, i = self.gate_multiplier, self.hidden_size, self.input_size
+        k1, k2, k3 = jax.random.split(key, 3)
+        params = {
+            "w_ih": _uniform(k1, (i, g * h), self.params_dtype, h),
+            "w_hh": _uniform(k2, (h, g * h), self.params_dtype, h),
+        }
+        if self.use_bias:
+            params["bias"] = jnp.zeros((g * h,), self.params_dtype)
+            params = self._init_bias(params)
+        return params
+
+    def _init_bias(self, params):
+        return params
+
+    def init_carry(self, batch: int, dtype=None) -> Any:
+        dtype = dtype or self.params_dtype
+        h = jnp.zeros((batch, self.hidden_size), dtype)
+        if self.n_hidden_states == 2:
+            return (h, h)
+        return h
+
+    def _gates(self, params, carry_h, x):
+        g = jnp.matmul(x, params["w_ih"].astype(x.dtype)) + jnp.matmul(
+            carry_h, params["w_hh"].astype(x.dtype)
+        )
+        if self.use_bias:
+            g = g + params["bias"].astype(g.dtype)
+        return g
+
+    def step(self, params, carry, x):
+        raise NotImplementedError
+
+    def apply(self, params, xs: jnp.ndarray,
+              carry: Optional[Any] = None) -> Tuple[Any, jnp.ndarray]:
+        """Run over (seq, batch, in); returns (final_carry, (seq, batch, h))."""
+        if carry is None:
+            carry = self.init_carry(xs.shape[1], xs.dtype)
+        return lax.scan(
+            lambda c, x: self.step(params, c, x), carry, xs
+        )
+
+
+class _TanhCell(RNNCell):
+    def step(self, params, carry, x):
+        h = jnp.tanh(self._gates(params, carry, x))
+        return h, h
+
+
+class _ReLUCell(RNNCell):
+    def step(self, params, carry, x):
+        h = jax.nn.relu(self._gates(params, carry, x))
+        return h, h
+
+
+class _LSTMCell(RNNCell):
+    gate_multiplier = 4
+    n_hidden_states = 2
+
+    def _init_bias(self, params):
+        # forget-gate bias init (reference: RNNBackend/models forget-bias
+        # fill) — gate order is (i, f, g, o) like torch
+        h = self.hidden_size
+        b = params["bias"]
+        params["bias"] = b.at[h : 2 * h].set(self.forget_bias)
+        return params
+
+    def step(self, params, carry, x):
+        h_prev, c_prev = carry
+        g = self._gates(params, h_prev, x)
+        i, f, cand, o = jnp.split(g, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(cand)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+
+class _GRUCell(RNNCell):
+    gate_multiplier = 3
+
+    def step(self, params, carry, x):
+        # torch GRU semantics: r,z from summed gates; n uses r * (h@Whn)
+        gi = jnp.matmul(x, params["w_ih"].astype(x.dtype))
+        gh = jnp.matmul(carry, params["w_hh"].astype(x.dtype))
+        if self.use_bias:
+            gi = gi + params["bias"].astype(gi.dtype)
+        ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(in_ + r * hn)
+        h = (1.0 - z) * n + z * carry
+        return h, h
+
+
+class _mLSTMCell(_LSTMCell):
+    """Multiplicative LSTM (reference: cells.py:12-55 ``mLSTMRNNCell``):
+    the hidden state is modulated by m = (x@Wmx) * (h@Wmh) before the
+    gates."""
+
+    def init(self, key) -> Dict[str, jnp.ndarray]:
+        k1, k2, k3 = jax.random.split(key, 3)
+        params = super().init(k1)
+        h, i = self.hidden_size, self.input_size
+        params["w_mih"] = _uniform(k2, (i, h), self.params_dtype, h)
+        params["w_mhh"] = _uniform(k3, (h, h), self.params_dtype, h)
+        return params
+
+    def step(self, params, carry, x):
+        h_prev, c_prev = carry
+        m = jnp.matmul(x, params["w_mih"].astype(x.dtype)) * jnp.matmul(
+            h_prev, params["w_mhh"].astype(x.dtype)
+        )
+        g = self._gates(params, m, x)
+        i, f, cand, o = jnp.split(g, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(cand)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+
+class StackedRNN:
+    """Stacked (and optionally bidirectional) container
+    (reference: RNNBackend.py ``stackedRNN``/``bidirectionalRNN``)."""
+
+    def __init__(self, cell_factory: Callable[[int, int], RNNCell],
+                 input_size: int, hidden_size: int, num_layers: int = 1,
+                 bidirectional: bool = False, dropout: float = 0.0):
+        self.num_layers = num_layers
+        self.bidirectional = bidirectional
+        self.dropout = dropout
+        self.cells = []
+        d = 2 if bidirectional else 1
+        for l in range(num_layers):
+            in_size = input_size if l == 0 else hidden_size * d
+            self.cells.append(cell_factory(in_size, hidden_size))
+            if bidirectional:
+                self.cells.append(cell_factory(in_size, hidden_size))
+
+    def init(self, key) -> list:
+        return [
+            c.init(k)
+            for c, k in zip(self.cells, jax.random.split(key, len(self.cells)))
+        ]
+
+    def apply(self, params: list, xs: jnp.ndarray,
+              rng: Optional[jax.Array] = None) -> jnp.ndarray:
+        h = xs
+        step = 2 if self.bidirectional else 1
+        for l in range(self.num_layers):
+            fwd_cell = self.cells[l * step]
+            _, fwd = fwd_cell.apply(params[l * step], h)
+            if self.bidirectional:
+                bwd_cell = self.cells[l * step + 1]
+                _, bwd = bwd_cell.apply(params[l * step + 1], h[::-1])
+                h = jnp.concatenate([fwd, bwd[::-1]], axis=-1)
+            else:
+                h = fwd
+            if self.dropout > 0.0 and rng is not None and l < self.num_layers - 1:
+                rng, sub = jax.random.split(rng)
+                keep = jax.random.bernoulli(sub, 1.0 - self.dropout, h.shape)
+                h = jnp.where(keep, h / (1.0 - self.dropout), 0.0)
+        return h
+
+
+def _model(cell_cls):
+    def factory(input_size: int, hidden_size: int, num_layers: int = 1,
+                bidirectional: bool = False, dropout: float = 0.0,
+                **cell_kw) -> StackedRNN:
+        return StackedRNN(
+            lambda i, h: cell_cls(i, h, **cell_kw),
+            input_size, hidden_size, num_layers, bidirectional, dropout,
+        )
+
+    return factory
+
+
+# reference: apex/RNN/models.py:8-53 — same factory names
+LSTM = _model(_LSTMCell)
+GRU = _model(_GRUCell)
+Tanh = _model(_TanhCell)
+ReLU = _model(_ReLUCell)
+mLSTM = _model(_mLSTMCell)

@@ -1,0 +1,265 @@
+"""Contrib batch 2 tests: groupbn, bottleneck (+ spatial parallel), RNN
+stack (vs torch CPU reference), weight norm, fp16_utils, batch samplers."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.contrib.bottleneck import Bottleneck, SpatialBottleneck
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    convert_network,
+    network_to_half,
+)
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.reparameterization import (
+    apply_weight_norm,
+    compute_weight,
+    remove_weight_norm,
+    weight_norm_init,
+)
+from apex_tpu.rnn import GRU, LSTM, Tanh, mLSTM
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.data import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+
+class TestGroupBN:
+    def test_matches_plain_bn(self):
+        bn = BatchNorm2d_NHWC(8, axis_name=None)
+        params = bn.init()
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 8))
+        out, new_params = bn.apply(params, x)
+        xf = np.asarray(x)
+        mean = xf.reshape(-1, 8).mean(0)
+        var = xf.reshape(-1, 8).var(0)
+        expected = (xf - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                                   atol=1e-5)
+        # running stats updated
+        assert not np.allclose(np.asarray(new_params["running_mean"]), 0)
+
+    def test_fused_add_relu(self):
+        bn = BatchNorm2d_NHWC(4, fuse_relu=True, axis_name=None)
+        params = bn.init()
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 3, 4))
+        z = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 3, 4))
+        out, _ = bn.apply(params, x, z=z)
+        assert (np.asarray(out) >= 0).all()
+
+
+class TestBottleneck:
+    def test_shapes_and_residual(self):
+        blk = Bottleneck(16, 4, 16)
+        params = blk.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 16))
+        y = blk.apply(params, x)
+        assert y.shape == x.shape
+        assert (np.asarray(y) >= 0).all()
+
+    def test_projection_path(self):
+        blk = Bottleneck(16, 4, 32, stride=2)
+        params = blk.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 16))
+        y = blk.apply(params, x)
+        assert y.shape == (2, 4, 4, 32)
+
+    def test_spatial_matches_dense(self):
+        """H-sharded spatial bottleneck == dense bottleneck (the halo
+        exchange + psum-BN must be transparent)."""
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size_=8
+        )
+        try:
+            dense = Bottleneck(8, 4, 8)
+            params = dense.init(jax.random.PRNGKey(0))
+            x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 8, 8))
+            ref = dense.apply(params, x)
+
+            spatial = SpatialBottleneck(8, 4, 8, axis_name="cp")
+            pspec = jax.tree.map(lambda _: P(), params)
+
+            fn = jax.jit(
+                jax.shard_map(
+                    spatial.apply,
+                    mesh=mesh,
+                    in_specs=(pspec, P(None, "cp")),
+                    out_specs=P(None, "cp"),
+                )
+            )
+            got = fn(params, x)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-5
+            )
+        finally:
+            parallel_state.destroy_model_parallel()
+
+
+class TestRNN:
+    def test_lstm_matches_torch(self):
+        import torch
+
+        model = LSTM(6, 8, num_layers=1)
+        params = model.init(jax.random.PRNGKey(0))
+        xs = np.random.default_rng(0).normal(size=(5, 2, 6)).astype(np.float32)
+        out = model.apply(params, jnp.asarray(xs))
+
+        t = torch.nn.LSTM(6, 8)
+        with torch.no_grad():
+            t.weight_ih_l0.copy_(torch.from_numpy(np.asarray(params[0]["w_ih"]).T))
+            t.weight_hh_l0.copy_(torch.from_numpy(np.asarray(params[0]["w_hh"]).T))
+            t.bias_ih_l0.copy_(torch.from_numpy(np.asarray(params[0]["bias"])))
+            t.bias_hh_l0.zero_()
+            ref, _ = t(torch.from_numpy(xs))
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_gru_matches_torch(self):
+        import torch
+
+        model = GRU(4, 6)
+        params = model.init(jax.random.PRNGKey(0))
+        xs = np.random.default_rng(1).normal(size=(5, 3, 4)).astype(np.float32)
+        out = model.apply(params, jnp.asarray(xs))
+
+        t = torch.nn.GRU(4, 6)
+        with torch.no_grad():
+            t.weight_ih_l0.copy_(torch.from_numpy(np.asarray(params[0]["w_ih"]).T))
+            t.weight_hh_l0.copy_(torch.from_numpy(np.asarray(params[0]["w_hh"]).T))
+            t.bias_ih_l0.copy_(torch.from_numpy(np.asarray(params[0]["bias"])))
+            t.bias_hh_l0.zero_()
+            ref, _ = t(torch.from_numpy(xs))
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_bidirectional_and_stacked(self):
+        model = LSTM(4, 6, num_layers=2, bidirectional=True)
+        params = model.init(jax.random.PRNGKey(0))
+        xs = jax.random.normal(jax.random.PRNGKey(1), (7, 2, 4))
+        out = model.apply(params, xs)
+        assert out.shape == (7, 2, 12)
+
+    def test_mlstm_and_tanh_run(self):
+        for factory in (mLSTM, Tanh):
+            model = factory(4, 4)
+            params = model.init(jax.random.PRNGKey(0))
+            out = model.apply(
+                params, jax.random.normal(jax.random.PRNGKey(1), (3, 2, 4))
+            )
+            assert out.shape == (3, 2, 4)
+            assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_lstm_forget_bias(self):
+        model = LSTM(4, 8, forget_bias=1.0)
+        params = model.init(jax.random.PRNGKey(0))
+        b = np.asarray(params[0]["bias"])
+        assert (b[8:16] == 1.0).all() and (b[:8] == 0).all()
+
+
+class TestWeightNorm:
+    def test_roundtrip(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (8, 4))
+        wn = weight_norm_init(w)
+        np.testing.assert_allclose(
+            np.asarray(compute_weight(wn)), np.asarray(w), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(remove_weight_norm(wn)), np.asarray(w), rtol=1e-6
+        )
+
+    def test_direction_invariance(self):
+        """Scaling v leaves w unchanged (the point of the param split)."""
+        w = jax.random.normal(jax.random.PRNGKey(0), (8, 4))
+        wn = weight_norm_init(w)
+        wn2 = {"g": wn["g"], "v": 3.0 * wn["v"]}
+        np.testing.assert_allclose(
+            np.asarray(compute_weight(wn2)), np.asarray(w), rtol=1e-6
+        )
+
+    def test_apply_to_pytree(self):
+        params = {"dense": {"weight": jnp.ones((4, 4)), "bias": jnp.zeros(4)}}
+        wn = apply_weight_norm(params)
+        assert set(wn["dense"]["weight"]) == {"g", "v"}
+        assert wn["dense"]["bias"].shape == (4,)
+
+
+class TestFP16Utils:
+    def test_network_to_half_and_convert(self):
+        params = {"w": jnp.ones((2, 2)), "step": jnp.int32(3),
+                  "ln": {"scale": jnp.ones(2)}}
+        half = network_to_half(params)
+        assert half["w"].dtype == jnp.float16
+        assert half["step"].dtype == jnp.int32
+        conv = convert_network(params, jnp.float16)
+        assert conv["w"].dtype == jnp.float16
+        assert conv["ln"]["scale"].dtype == jnp.float32  # norm stays fp32
+
+    def test_fp16_optimizer_trains_and_skips_overflow(self):
+        opt = FP16_Optimizer(FusedAdam(lr=0.1), dynamic_loss_scale=True)
+        params = {"w": jnp.ones((4,), jnp.float16)}
+        state = opt.init(params)
+        scale0 = float(state["scaler"].loss_scale)
+
+        # build in fp32 then cast: 65536 itself overflows fp16
+        grads = {"w": (jnp.full((4,), 0.25) * scale0).astype(jnp.float16)}
+        new_params, state = opt.step(state, grads, params)
+        assert not np.allclose(np.asarray(new_params["w"]),
+                               np.asarray(params["w"]))
+
+        inf_grads = {"w": jnp.full((4,), np.inf, jnp.float16)}
+        skipped, state2 = opt.step(state, inf_grads, new_params)
+        np.testing.assert_array_equal(
+            np.asarray(skipped["w"]), np.asarray(new_params["w"])
+        )
+        assert float(state2["scaler"].loss_scale) < float(
+            state["scaler"].loss_scale
+        )
+
+    def test_state_dict_roundtrip(self):
+        opt = FP16_Optimizer(FusedAdam(lr=0.1), dynamic_loss_scale=True)
+        params = {"w": jnp.ones((4,), jnp.float16)}
+        state = opt.init(params)
+        d = opt.state_dict(state)
+        state2 = opt.load_state_dict(d)
+        assert float(state2["scaler"].loss_scale) == float(
+            state["scaler"].loss_scale
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state2["master"]["w"]), np.asarray(state["master"]["w"])
+        )
+
+
+class TestSamplers:
+    def test_sequential_shards_by_rank(self):
+        batches0 = list(MegatronPretrainingSampler(32, 0, 2, 0, 2))
+        batches1 = list(MegatronPretrainingSampler(32, 0, 2, 1, 2))
+        assert batches0[0] == [0, 1] and batches1[0] == [2, 3]
+        assert len(batches0) == 8  # 32 / (2*2)
+        flat = sorted(i for b in batches0 + batches1 for i in b)
+        assert flat == list(range(32))
+
+    def test_sequential_resume(self):
+        batches = list(MegatronPretrainingSampler(32, 16, 2, 0, 2))
+        assert batches[0] == [16, 17]
+
+    def test_sequential_errors(self):
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingSampler(0, 0, 2, 0, 2)
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingSampler(8, 8, 2, 0, 2)
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingSampler(8, 0, 2, 3, 2)
+
+    def test_random_is_epoch_deterministic_and_disjoint(self):
+        a0 = list(MegatronPretrainingRandomSampler(64, 0, 2, 0, 2))
+        a0b = list(MegatronPretrainingRandomSampler(64, 0, 2, 0, 2))
+        assert a0 == a0b
+        a1 = list(MegatronPretrainingRandomSampler(64, 0, 2, 1, 2))
+        seen0 = {i for b in a0 for i in b}
+        seen1 = {i for b in a1 for i in b}
+        assert not (seen0 & seen1)
