@@ -40,6 +40,16 @@ from apex_tpu.amp.scaler import (  # noqa: F401
     all_finite,
     scale_gradients,
 )
+from apex_tpu.amp.functional import (  # noqa: F401
+    bfloat16_function,
+    float_function,
+    half_function,
+    promote_function,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
+    set_low_precision_dtype,
+)
 
 __all__ = [
     "Policy",
@@ -53,6 +63,14 @@ __all__ = [
     "initialize",
     "tree_cast",
     "is_norm_param",
+    "half_function",
+    "bfloat16_function",
+    "float_function",
+    "promote_function",
+    "register_half_function",
+    "register_float_function",
+    "register_promote_function",
+    "set_low_precision_dtype",
 ]
 
 

@@ -1,0 +1,122 @@
+"""Function-level cast decorators — the O1 "patch" analog.
+
+The reference monkey-patches torch namespaces against whitelists
+(reference: apex/amp/amp.py:29-71 decorators, :75-198 init;
+apex/amp/wrap.py:10-85 cast wrappers; cast lists in apex/amp/lists/).
+JAX functions can't be patched behind the tracer's back — and don't need
+to be: these decorators wrap *your* functions at definition site with
+the same semantics (cast array args to the target dtype, run, return),
+and a registry records them so a policy sweep can flip the low-precision
+dtype globally (fp16 ↔ bf16, the O1 ↔ O4 switch).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "half_function",
+    "bfloat16_function",
+    "float_function",
+    "promote_function",
+    "register_half_function",
+    "register_float_function",
+    "register_promote_function",
+    "set_low_precision_dtype",
+]
+
+# the process-global low-precision dtype; O1 uses fp16, O4 bf16
+_LOW_PRECISION: Dict[str, Any] = {"dtype": jnp.bfloat16}
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def set_low_precision_dtype(dtype) -> None:
+    """Flip the dtype every ``half_function`` casts to (the O1→O4 move;
+    reference: apex/amp/frontend.py O4 sets cast_model_type bf16)."""
+    _LOW_PRECISION["dtype"] = dtype
+
+
+def _cast_tree(args, dtype):
+    def cast(x):
+        if isinstance(x, jnp.ndarray) and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, args)
+
+
+def _wrap(fn: Callable, dtype_fn: Callable[[], Any]) -> Callable:
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        dtype = dtype_fn()
+        args = _cast_tree(args, dtype)
+        kwargs = _cast_tree(kwargs, dtype)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def half_function(fn: Callable) -> Callable:
+    """Run in the low-precision dtype (reference: amp.py ``half_function``;
+    fp16 under O1, bf16 under O4 — controlled by
+    :func:`set_low_precision_dtype`)."""
+    wrapped = _wrap(fn, lambda: _LOW_PRECISION["dtype"])
+    _REGISTRY[getattr(fn, "__name__", repr(fn))] = wrapped
+    return wrapped
+
+
+def bfloat16_function(fn: Callable) -> Callable:
+    """(reference: amp.py ``bfloat16_function``)"""
+    return _wrap(fn, lambda: jnp.bfloat16)
+
+
+def float_function(fn: Callable) -> Callable:
+    """Always fp32 — the blacklist (reference: amp.py ``float_function``)."""
+    return _wrap(fn, lambda: jnp.float32)
+
+
+def promote_function(fn: Callable) -> Callable:
+    """Cast every float arg to the widest float dtype present
+    (reference: amp.py ``promote_function``, wrap.py ``promote``)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        leaves = [
+            x
+            for x in jax.tree.leaves((args, kwargs))
+            if isinstance(x, jnp.ndarray)
+            and jnp.issubdtype(x.dtype, jnp.floating)
+        ]
+        if leaves:
+            widest = functools.reduce(
+                jnp.promote_types, [l.dtype for l in leaves]
+            )
+            args = _cast_tree(args, widest)
+            kwargs = _cast_tree(kwargs, widest)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+# module-level registration, for parity with the reference's
+# register_* API (reference: apex/amp/amp.py:46-71) — in JAX "module" is
+# just a namespace object, so these rebind the attribute
+def _register(module, name: str, deco: Callable) -> None:
+    fn = getattr(module, name)
+    setattr(module, name, deco(fn))
+
+
+def register_half_function(module, name: str) -> None:
+    _register(module, name, half_function)
+
+
+def register_float_function(module, name: str) -> None:
+    _register(module, name, float_function)
+
+
+def register_promote_function(module, name: str) -> None:
+    _register(module, name, promote_function)

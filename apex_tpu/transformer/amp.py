@@ -1,0 +1,55 @@
+"""Model-parallel grad scaler: overflow consensus across tp/pp ranks.
+
+Capability match of ``apex.transformer.amp.GradScaler``
+(reference: apex/transformer/amp/grad_scaler.py:8-106), which all-reduces
+``found_inf`` (MAX) over the model-parallel group so every rank of a
+tensor/pipeline-parallel model agrees on skipping a step.  Here the
+consensus is a pmin of the finite flag over the model-parallel mesh
+axes, folded into the scaler's unscale.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.transformer.parallel_state import (
+    PIPELINE_PARALLEL_AXIS,
+    TENSOR_PARALLEL_AXIS,
+)
+
+__all__ = ["GradScaler", "model_parallel_all_finite"]
+
+
+def model_parallel_all_finite(
+    finite: jnp.ndarray,
+    axis_names: Sequence[str] = (
+        TENSOR_PARALLEL_AXIS,
+        PIPELINE_PARALLEL_AXIS,
+    ),
+) -> jnp.ndarray:
+    """AND-reduce a per-rank finite flag over the model-parallel axes
+    (the reference's MAX-allreduce of found_inf, grad_scaler.py:25-36,
+    with the polarity flipped: finite = NOT found_inf)."""
+    out = finite.astype(jnp.int32)
+    for ax in axis_names:
+        out = jax.lax.pmin(out, ax)
+    return out.astype(bool)
+
+
+class GradScaler(LossScaler):
+    """LossScaler whose overflow check reaches model-parallel consensus —
+    call inside shard_map over a mesh with the given axes."""
+
+    def __init__(self, *args, axis_names: Sequence[str] = (
+        TENSOR_PARALLEL_AXIS, PIPELINE_PARALLEL_AXIS
+    ), **kwargs):
+        super().__init__(*args, **kwargs)
+        self.axis_names = tuple(axis_names)
+
+    def unscale(self, state: ScalerState, grads: Any) -> Tuple[Any, jnp.ndarray]:
+        grads, finite = super().unscale(state, grads)
+        return grads, model_parallel_all_finite(finite, self.axis_names)

@@ -1,0 +1,118 @@
+"""amp cast decorators + model-parallel GradScaler tests (the reference's
+test_basic_casts.py / test_promotion.py analog, SURVEY.md §4)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.amp import (
+    bfloat16_function,
+    float_function,
+    half_function,
+    promote_function,
+    set_low_precision_dtype,
+)
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.amp import GradScaler, model_parallel_all_finite
+
+
+def dtype_probe(*args, **kwargs):
+    return jax.tree.leaves((args, kwargs))[0].dtype
+
+
+class TestCastDecorators:
+    def teardown_method(self):
+        set_low_precision_dtype(jnp.bfloat16)
+
+    def test_half_function_default_bf16(self):
+        f = half_function(dtype_probe)
+        assert f(jnp.ones(3)) == jnp.bfloat16
+
+    def test_half_function_fp16_mode(self):
+        set_low_precision_dtype(jnp.float16)
+        f = half_function(dtype_probe)
+        assert f(jnp.ones(3)) == jnp.float16
+
+    def test_float_function(self):
+        f = float_function(dtype_probe)
+        assert f(jnp.ones(3, jnp.bfloat16)) == jnp.float32
+
+    def test_bfloat16_function(self):
+        f = bfloat16_function(dtype_probe)
+        assert f(jnp.ones(3, jnp.float32)) == jnp.bfloat16
+
+    def test_promote_widest_wins(self):
+        f = promote_function(dtype_probe)
+        assert f(jnp.ones(3, jnp.bfloat16), jnp.ones(3, jnp.float32)) == (
+            jnp.float32
+        )
+
+    def test_int_args_pass_through(self):
+        @half_function
+        def probe(x, i):
+            return x.dtype, i.dtype
+
+        xd, idt = probe(jnp.ones(3), jnp.arange(3))
+        assert xd == jnp.bfloat16 and idt == jnp.int32
+
+    def test_value_preserved(self):
+        @float_function
+        def add(a, b):
+            return a + b
+
+        out = add(jnp.ones(3, jnp.bfloat16), jnp.ones(3, jnp.bfloat16))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+class TestModelParallelGradScaler:
+    def test_consensus_across_tp(self):
+        """A rank-local overflow must veto the step on every rank
+        (reference: apex/transformer/amp/grad_scaler.py:25-36)."""
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=4
+        )
+        try:
+            scaler = GradScaler(axis_names=("tp",))
+            state = scaler.init()
+
+            def check(grads):
+                # grads sharded over tp: only one rank sees the inf
+                unscaled, finite = scaler.unscale(state, grads)
+                return finite
+
+            grads = jnp.zeros((4, 2)).at[2, 0].set(np.inf)
+            finite = jax.jit(
+                jax.shard_map(
+                    check, mesh=mesh, in_specs=(P("tp"),), out_specs=P(),
+                )
+            )(grads)
+            assert not bool(finite)
+
+            finite_ok = jax.jit(
+                jax.shard_map(
+                    check, mesh=mesh, in_specs=(P("tp"),), out_specs=P(),
+                )
+            )(jnp.zeros((4, 2)))
+            assert bool(finite_ok)
+        finally:
+            parallel_state.destroy_model_parallel()
+
+    def test_all_finite_helper(self):
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=2
+        )
+        try:
+            def f(x):
+                local_finite = jnp.all(jnp.isfinite(x))
+                return model_parallel_all_finite(local_finite, ("tp",))
+
+            x = jnp.zeros((2, 2)).at[1, 1].set(np.nan)
+            out = jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=(P("tp"),),
+                              out_specs=P())
+            )(x)
+            assert not bool(out)
+        finally:
+            parallel_state.destroy_model_parallel()
