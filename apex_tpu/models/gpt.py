@@ -76,6 +76,10 @@ class GPTConfig:
     remat: bool = True
     remat_policy: Optional[str] = "dots_saveable"
     attention_impl: Optional[str] = None  # None → pick by platform
+    # shard the sequence dim over the "cp" mesh axis and use ring
+    # attention — long-context training (new capability vs the reference,
+    # SURVEY.md §2.3); tokens then arrive as the local (b, s/cp) shard
+    context_parallel: bool = False
 
     def __post_init__(self):
         if self.ffn_hidden_size is None:
@@ -83,6 +87,11 @@ class GPTConfig:
         if self.hidden_size % self.num_attention_heads:
             raise ValueError(
                 "hidden_size must be divisible by num_attention_heads"
+            )
+        if self.context_parallel and self.attention_dropout > 0.0:
+            raise ValueError(
+                "attention_dropout is not supported with context_parallel "
+                "(the explicit-softmax dropout path is not ring-aware)"
             )
 
     @property
@@ -254,6 +263,10 @@ class GPTModel:
             attn = jnp.einsum(
                 "bhqk,bhkd->bhqd", probs.astype(v.dtype), v
             )
+        elif c.context_parallel:
+            from apex_tpu.ops.ring_attention import ring_attention
+
+            attn = ring_attention(q, k, v, causal=True)
         else:
             attn = flash_attention(
                 q, k, v, causal=True, implementation=c.attention_impl
@@ -294,7 +307,20 @@ class GPTModel:
         c = self.config
         b, s = tokens.shape
         x = self.embedding.apply(params["embedding"], tokens)
-        x = x + params["pos_embedding"][:s][None, :, :].astype(x.dtype)
+        if c.context_parallel:
+            # tokens are the local shard of the sequence: position ids
+            # start at cp_rank * s_local
+            from apex_tpu.transformer.parallel_state import (
+                CONTEXT_PARALLEL_AXIS,
+            )
+
+            offset = jax.lax.axis_index(CONTEXT_PARALLEL_AXIS) * s
+            pos = jax.lax.dynamic_slice_in_dim(
+                params["pos_embedding"], offset, s, axis=0
+            )
+        else:
+            pos = params["pos_embedding"][:s]
+        x = x + pos[None, :, :].astype(x.dtype)
         x = x.astype(c.compute_dtype)
 
         use_rng = rng is not None
@@ -354,7 +380,14 @@ class GPTModel:
             logits, targets, axis_name=self.axis_name
         )
         loss = jnp.mean(per_token)
-        return jax.lax.pmean(loss, DATA_PARALLEL_AXIS)
+        loss = jax.lax.pmean(loss, DATA_PARALLEL_AXIS)
+        if self.config.context_parallel:
+            from apex_tpu.transformer.parallel_state import (
+                CONTEXT_PARALLEL_AXIS,
+            )
+
+            loss = jax.lax.pmean(loss, CONTEXT_PARALLEL_AXIS)
+        return loss
 
     # ------------------------------------------------------ pipeline path
     def pipeline_param_specs(self) -> Dict[str, Any]:

@@ -1,0 +1,115 @@
+"""Ring attention: exact attention over a context-parallel mesh axis.
+
+This is new TPU-first capability beyond the reference (SURVEY.md §2.3:
+"No ring-attention / Ulysses / context parallelism exists in this
+snapshot" — the nearest analog is the SpatialBottleneck halo exchange,
+reference: apex/contrib/bottleneck/bottleneck.py:218-385).  The sequence
+dimension is sharded over the "cp" axis; K/V shards rotate around the
+ring with ``ppermute`` while every rank accumulates its queries' online
+softmax — after ``cp`` steps each query has attended to the full global
+sequence, with per-chip memory O(S/cp) and the K/V transfer overlapping
+the attention compute of the previous block (XLA's latency-hiding
+scheduler handles the overlap; the ring pattern rides neighbour ICI
+links by construction).
+
+Causality uses global position ids, so rank boundaries are invisible to
+the math: the result equals dense causal attention on the gathered
+sequence (tested to 1e-5).
+
+Backward falls out of autodiff through the scan: cotangents ride the
+reverse ring.  ``remat=True`` recomputes each block's scores in the
+backward pass instead of saving cp score matrices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import CONTEXT_PARALLEL_AXIS
+
+__all__ = ["ring_attention", "ring_attention_reference"]
+
+_NEG = -1e30
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = CONTEXT_PARALLEL_AXIS,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Attention over the global sequence from per-rank shards.
+
+    ``q``, ``k``, ``v``: (batch, heads, s_local, head_dim) — the local
+    contiguous shard of a sequence of length ``cp * s_local``.  Call
+    inside ``shard_map`` with the sequence dim sharded over ``axis_name``.
+    Returns the local shard of the attention output.
+    """
+    b, h, s_local, d = q.shape
+    scale = (1.0 / d**0.5) if sm_scale is None else float(sm_scale)
+    cp = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    q32 = q.astype(jnp.float32) * scale
+    qpos = rank * s_local + jnp.arange(s_local)
+
+    def block(carry, i):
+        k_blk, v_blk, acc, m, l = carry
+        src = (rank - i) % cp  # whose K/V shard we currently hold
+        kpos = src * s_local + jnp.arange(s_local)
+
+        def attend(k_blk, v_blk, acc, m, l):
+            s = jnp.einsum(
+                "bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            if causal:
+                s = jnp.where(kpos[None, None, None, :] >
+                              qpos[None, None, :, None], _NEG, s)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * corr + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            return acc_new, m_new, l_new
+
+        fn = jax.checkpoint(attend) if remat else attend
+        acc, m, l = fn(k_blk, v_blk, acc, m, l)
+        # rotate K/V one step around the ring
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, acc, m, l), None
+
+    # build the accumulators from q so they carry its varying-axes type
+    # (a plain zeros constant would mismatch the scan carry under
+    # shard_map's vma checking)
+    zero_q = q32 * 0
+    acc0 = zero_q
+    m0 = jnp.sum(zero_q, axis=-1, keepdims=True) + _NEG
+    l0 = jnp.sum(zero_q, axis=-1, keepdims=True)
+    (k_fin, v_fin, acc, m, l), _ = lax.scan(
+        block, (k, v, acc0, m0, l0), jnp.arange(cp)
+    )
+    del k_fin, v_fin  # back where they started after cp rotations
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention_reference(q, k, v, causal=False, sm_scale=None):
+    """Dense single-device reference (for tests): plain attention on the
+    full gathered sequence."""
+    from apex_tpu.ops.attention import mha_reference
+
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
