@@ -64,7 +64,9 @@ def convert_network(params: Any, dtype=jnp.float16,
 def prep_param_lists(params: Any) -> Tuple[Any, Any]:
     """(model_params, fp32 master copy)
     (reference: fp16util.py:90 ``prep_param_lists``)."""
-    master = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params)
+    master = jax.tree.map(
+        lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params
+    )
     return params, master
 
 

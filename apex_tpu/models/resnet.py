@@ -1,0 +1,221 @@
+"""ResNet (18/34/50/101/152) — the imagenet benchmark model family.
+
+The reference ships ResNet-50 training as its flagship example
+(reference: examples/imagenet/main_amp.py, model from torchvision) and
+its north-star benchmark is RN50 images/sec under amp O2 (BASELINE.md).
+TPU-native build: NHWC layout, SyncBatchNorm statistics psum-ed over the
+dp axis (reference: apex/parallel/optimized_sync_batchnorm.py), bf16
+compute with fp32 BN, functional (params, batch_stats) in/out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
+from apex_tpu.transformer.parallel_state import DATA_PARALLEL_AXIS
+
+__all__ = ["ResNetConfig", "ResNet", "resnet50"]
+
+_DEPTHS = {
+    18: ((2, 2, 2, 2), False),
+    34: ((3, 4, 6, 3), False),
+    50: ((3, 4, 6, 3), True),
+    101: ((3, 4, 23, 3), True),
+    152: ((3, 8, 36, 3), True),
+}
+
+
+@dataclasses.dataclass
+class ResNetConfig:
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    # None → local-batch BN; "dp" → SyncBN over the data-parallel axis
+    sync_bn_axis: Optional[str] = DATA_PARALLEL_AXIS
+
+    def __post_init__(self):
+        if self.depth not in _DEPTHS:
+            raise ValueError(f"unsupported depth {self.depth}")
+        self.stage_blocks, self.bottleneck = _DEPTHS[self.depth]
+
+
+def _he(key, shape, dtype):
+    fan_in = shape[0] * shape[1] * shape[2]
+    return math.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+class ResNet:
+    """Functional ResNet: ``init(key)`` → (params, batch_stats);
+    ``apply(params, batch_stats, x, training)`` → (logits, new_stats)."""
+
+    def __init__(self, config: ResNetConfig):
+        self.config = config
+
+    # ---------------------------------------------------------------- init
+    def _bn_init(self, c, zero_scale=False):
+        return (
+            {
+                "scale": jnp.full(
+                    (c,), 0.0 if zero_scale else 1.0, self.config.params_dtype
+                ),
+                "bias": jnp.zeros((c,), self.config.params_dtype),
+            },
+            {
+                "mean": jnp.zeros((c,), jnp.float32),
+                "var": jnp.ones((c,), jnp.float32),
+            },
+        )
+
+    def _block_init(self, key, c_in, c_mid, c_out, stride):
+        c = self.config
+        ks = jax.random.split(key, 4)
+        params, stats = {}, {}
+        if c.bottleneck:
+            shapes = [
+                ("conv1", (1, 1, c_in, c_mid)),
+                ("conv2", (3, 3, c_mid, c_mid)),
+                ("conv3", (1, 1, c_mid, c_out)),
+            ]
+        else:
+            shapes = [
+                ("conv1", (3, 3, c_in, c_mid)),
+                ("conv2", (3, 3, c_mid, c_out)),
+            ]
+        for i, (name, shape) in enumerate(shapes):
+            params[name] = _he(ks[i], shape, c.params_dtype)
+            # zero-init the last BN scale of each block (the torchvision /
+            # reference recipe for large-batch stability)
+            last = i == len(shapes) - 1
+            params[f"bn{i+1}"], stats[f"bn{i+1}"] = self._bn_init(
+                shape[-1], zero_scale=last
+            )
+        if stride != 1 or c_in != c_out:
+            params["conv_proj"] = _he(
+                ks[3], (1, 1, c_in, c_out), c.params_dtype
+            )
+            params["bn_proj"], stats["bn_proj"] = self._bn_init(c_out)
+        return params, stats
+
+    def init(self, key) -> Tuple[dict, dict]:
+        c = self.config
+        expansion = 4 if c.bottleneck else 1
+        keys = jax.random.split(key, 6)
+        params = {"conv_stem": _he(keys[0], (7, 7, 3, c.width), c.params_dtype)}
+        stats = {}
+        params["bn_stem"], stats["bn_stem"] = self._bn_init(c.width)
+
+        c_in = c.width
+        stages_p, stages_s = [], []
+        for s, blocks in enumerate(c.stage_blocks):
+            c_mid = c.width * (2**s)
+            c_out = c_mid * expansion
+            bkeys = jax.random.split(keys[1 + s], blocks)
+            stage_p, stage_s = [], []
+            for b in range(blocks):
+                stride = 2 if (s > 0 and b == 0) else 1
+                p, st = self._block_init(bkeys[b], c_in, c_mid, c_out, stride)
+                stage_p.append(p)
+                stage_s.append(st)
+                c_in = c_out
+            stages_p.append(stage_p)
+            stages_s.append(stage_s)
+        params["stages"] = stages_p
+        stats["stages"] = stages_s
+
+        fan_in = c_in
+        params["fc"] = {
+            "weight": jax.random.normal(
+                keys[5], (fan_in, c.num_classes), c.params_dtype
+            ) / math.sqrt(fan_in),
+            "bias": jnp.zeros((c.num_classes,), c.params_dtype),
+        }
+        return params, stats
+
+    # ------------------------------------------------------------- forward
+    def _bn(self, p, st, x, training):
+        c = self.config
+        out, mean, var = sync_batch_norm(
+            x, p["scale"], p["bias"], st["mean"], st["var"],
+            training=training, momentum=c.bn_momentum, eps=c.bn_eps,
+            axis_name=c.sync_bn_axis if training else None,
+        )
+        return out, {"mean": mean, "var": var}
+
+    def _block(self, p, st, x, stride, training):
+        c = self.config
+        new_st = {}
+        identity = x
+        if c.bottleneck:
+            h, new_st["bn1"] = self._bn(p["bn1"], st["bn1"],
+                                        _conv(x, p["conv1"]), training)
+            h = jax.nn.relu(h)
+            h, new_st["bn2"] = self._bn(p["bn2"], st["bn2"],
+                                        _conv(h, p["conv2"], stride), training)
+            h = jax.nn.relu(h)
+            h, new_st["bn3"] = self._bn(p["bn3"], st["bn3"],
+                                        _conv(h, p["conv3"]), training)
+        else:
+            h, new_st["bn1"] = self._bn(p["bn1"], st["bn1"],
+                                        _conv(x, p["conv1"], stride), training)
+            h = jax.nn.relu(h)
+            h, new_st["bn2"] = self._bn(p["bn2"], st["bn2"],
+                                        _conv(h, p["conv2"]), training)
+        if "conv_proj" in p:
+            identity, new_st["bn_proj"] = self._bn(
+                p["bn_proj"], st["bn_proj"],
+                _conv(x, p["conv_proj"], stride), training,
+            )
+        return jax.nn.relu(h + identity), new_st
+
+    def apply(self, params: dict, batch_stats: dict, x: jnp.ndarray,
+              training: bool = True) -> Tuple[jnp.ndarray, dict]:
+        """x: (N, H, W, 3) NHWC.  Returns (logits, new_batch_stats)."""
+        c = self.config
+        x = x.astype(c.compute_dtype)
+        new_stats = {}
+        h = _conv(x, params["conv_stem"], stride=2)
+        h, new_stats["bn_stem"] = self._bn(
+            params["bn_stem"], batch_stats["bn_stem"], h, training
+        )
+        h = jax.nn.relu(h)
+        h = lax.reduce_window(
+            h, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+        )
+        stage_stats = []
+        for s, stage in enumerate(params["stages"]):
+            blk_stats = []
+            for b, blk in enumerate(stage):
+                stride = 2 if (s > 0 and b == 0) else 1
+                h, st = self._block(
+                    blk, batch_stats["stages"][s][b], h, stride, training
+                )
+                blk_stats.append(st)
+            stage_stats.append(blk_stats)
+        new_stats["stages"] = stage_stats
+        h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
+        logits = h @ params["fc"]["weight"].astype(jnp.float32) + params[
+            "fc"
+        ]["bias"].astype(jnp.float32)
+        return logits, new_stats
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(ResNetConfig(depth=50, **kw))

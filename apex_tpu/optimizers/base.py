@@ -69,8 +69,11 @@ class FusedOptimizer:
         state = {"step": jnp.int32(0)}
         state.update(self._init_extra(params))
         if self.master_weights:
+            # copy=True: asarray on an fp32 param would alias the same
+            # buffer, and donating params + state together then donates
+            # one buffer twice
             state["master"] = jax.tree.map(
-                lambda p: jnp.asarray(p, jnp.float32), params
+                lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params
             )
         return state
 

@@ -1,0 +1,126 @@
+"""DCGAN with multiple models, optimizers, and loss scalers
+(reference: examples/dcgan/main_amp.py:214-253 — the multi-loss amp
+workflow: amp.initialize([netD, netG], [optD, optG], num_losses=3) and
+three scale_loss ids for errD_real, errD_fake, errG).
+
+Synthetic data; small nets so it runs on CPU devices too.
+
+    python examples/dcgan_amp.py --steps 50
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedAdam
+
+IMG = 16
+LATENT = 32
+
+
+def init_g(key):
+    k1, k2 = jax.random.split(key)
+    return {
+        "fc": 0.05 * jax.random.normal(k1, (LATENT, 256)),
+        "out": 0.05 * jax.random.normal(k2, (256, IMG * IMG)),
+    }
+
+
+def init_d(key):
+    k1, k2 = jax.random.split(key)
+    return {
+        "fc": 0.05 * jax.random.normal(k1, (IMG * IMG, 256)),
+        "out": 0.05 * jax.random.normal(k2, (256, 1)),
+    }
+
+
+def gen(params, z):
+    h = jax.nn.leaky_relu(z @ params["fc"])
+    return jnp.tanh(h @ params["out"])
+
+
+def disc(params, x):
+    h = jax.nn.leaky_relu(x @ params["fc"])
+    return (h @ params["out"])[:, 0]
+
+
+def bce(logits, target):
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * target
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    # one MixedPrecision handle, three loss ids — errD_real, errD_fake,
+    # errG — exactly the reference's per-loss scaler setup
+    mp = amp.initialize(opt_level="O1", num_losses=3)
+    amp_state = mp.init()
+    opt_d, opt_g = FusedAdam(lr=2e-4), FusedAdam(lr=2e-4)
+
+    params_d, params_g = init_d(jax.random.PRNGKey(0)), init_g(
+        jax.random.PRNGKey(1)
+    )
+    opt_state_d, opt_state_g = opt_d.init(params_d), opt_g.init(params_g)
+
+    @jax.jit
+    def train_step(params_d, params_g, opt_state_d, opt_state_g,
+                   amp_state, real, z, z2):
+        # --- D step: two losses, two scalers ------------------------
+        def d_loss_real(pd):
+            return mp.scale_loss(amp_state, bce(disc(pd, real), 1.0), 0)
+
+        def d_loss_fake(pd):
+            fake = gen(params_g, z)
+            return mp.scale_loss(
+                amp_state, bce(disc(pd, jax.lax.stop_gradient(fake)), 0.0), 1
+            )
+
+        g_real = jax.grad(d_loss_real)(params_d)
+        g_fake = jax.grad(d_loss_fake)(params_d)
+        g_real, fin0, amp_state = mp.unscale_and_adjust(amp_state, g_real, 0)
+        g_fake, fin1, amp_state = mp.unscale_and_adjust(amp_state, g_fake, 1)
+        grads_d = jax.tree.map(jnp.add, g_real, g_fake)
+        params_d, opt_state_d = opt_d.step(
+            opt_state_d, grads_d, params_d, grads_finite=fin0 & fin1
+        )
+
+        # --- G step: third scaler ------------------------------------
+        def g_loss(pg):
+            return mp.scale_loss(
+                amp_state, bce(disc(params_d, gen(pg, z2)), 1.0), 2
+            )
+
+        grads_g = jax.grad(g_loss)(params_g)
+        grads_g, fin2, amp_state = mp.unscale_and_adjust(amp_state, grads_g, 2)
+        params_g, opt_state_g = opt_g.step(
+            opt_state_g, grads_g, params_g, grads_finite=fin2
+        )
+        return params_d, params_g, opt_state_d, opt_state_g, amp_state
+
+    rng = np.random.default_rng(0)
+    for i in range(args.steps):
+        real = jnp.asarray(
+            rng.normal(size=(args.batch, IMG * IMG)).astype(np.float32)
+        )
+        z = jnp.asarray(rng.normal(size=(args.batch, LATENT)).astype(np.float32))
+        z2 = jnp.asarray(rng.normal(size=(args.batch, LATENT)).astype(np.float32))
+        params_d, params_g, opt_state_d, opt_state_g, amp_state = train_step(
+            params_d, params_g, opt_state_d, opt_state_g, amp_state,
+            real, z, z2,
+        )
+    scales = [float(s.loss_scale) for s in amp_state.scaler_states]
+    print(f"done {args.steps} steps; loss scales: {scales}")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""ImageNet-style ResNet training — the flagship throughput example
+(reference: examples/imagenet/main_amp.py: RN50 + amp O2 + apex DDP +
+SyncBN).  Synthetic data by default so it runs without a dataset; plug a
+real input pipeline into `batches()` for actual training.
+
+    python examples/imagenet_amp.py --depth 50 --batch-size 32 --steps 20
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.models.resnet import ResNet, ResNetConfig
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.transformer import parallel_state
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=50)
+    ap.add_argument("--batch-size", type=int, default=32,
+                    help="per-device batch")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    args = ap.parse_args()
+
+    mesh = parallel_state.initialize_model_parallel()
+    dp = mesh.shape["dp"]
+    model = ResNet(ResNetConfig(depth=args.depth,
+                                num_classes=args.num_classes))
+    # O2 analog: bf16 compute (model casts internally), fp32 masters in
+    # the optimizer, BN in fp32 (sync over dp)
+    opt = FusedSGD(lr=args.lr, momentum=0.9, weight_decay=1e-4,
+                   master_weights=True)
+
+    params, bn_stats = model.init(jax.random.PRNGKey(0))
+    opt_state = opt.init(params)
+
+    def train_step(params, opt_state, bn_stats, images, labels):
+        def loss_fn(p, stats):
+            logits, new_stats = model.apply(p, stats, images, training=True)
+            one_hot = jax.nn.one_hot(labels, args.num_classes)
+            loss = -jnp.mean(
+                jnp.sum(jax.nn.log_softmax(logits) * one_hot, axis=-1)
+            )
+            return loss, new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, bn_stats)
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+        # BN running stats: average across dp like the reference's SyncBN
+        new_stats = jax.tree.map(
+            lambda s: jax.lax.pmean(s, "dp"), new_stats
+        )
+        new_params, new_opt = opt.step(opt_state, grads, params)
+        return new_params, new_opt, new_stats, jax.lax.pmean(loss, "dp")
+
+    to_spec = lambda tree: jax.tree.map(lambda _: P(), tree)
+    step = jax.jit(
+        jax.shard_map(
+            train_step, mesh=mesh,
+            in_specs=(to_spec(params), to_spec(opt_state), to_spec(bn_stats),
+                      P("dp"), P("dp")),
+            out_specs=(to_spec(params), to_spec(opt_state),
+                       to_spec(bn_stats), P()),
+        ),
+        donate_argnums=(0, 1, 2),
+    )
+
+    rng = np.random.default_rng(0)
+    global_batch = args.batch_size * dp
+    images = jnp.asarray(rng.normal(
+        size=(global_batch, args.image_size, args.image_size, 3)
+    ).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, args.num_classes, (global_batch,)))
+
+    # warmup/compile
+    params, opt_state, bn_stats, loss = step(
+        params, opt_state, bn_stats, images, labels
+    )
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, bn_stats, loss = step(
+            params, opt_state, bn_stats, images, labels
+        )
+    lv = float(loss)
+    dt = time.perf_counter() - t0
+    ips = global_batch * args.steps / dt
+    print(f"loss {lv:.3f}  {dt / args.steps * 1e3:.1f} ms/step  "
+          f"{ips:,.1f} images/sec ({ips / max(jax.device_count(), 1):,.1f}"
+          f"/chip)")
+
+
+if __name__ == "__main__":
+    main()
