@@ -6,6 +6,16 @@ tensor/pipeline toolkit; here they are first-class models (and the
 flagship benchmark drivers).
 """
 
+from apex_tpu.models.bert import BertConfig, BertModel
 from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.models.resnet import ResNet, ResNetConfig, resnet50
 
-__all__ = ["GPTConfig", "GPTModel"]
+__all__ = [
+    "GPTConfig",
+    "GPTModel",
+    "BertConfig",
+    "BertModel",
+    "ResNet",
+    "ResNetConfig",
+    "resnet50",
+]

@@ -1,0 +1,336 @@
+"""Megatron-style BERT — bidirectional encoder with MLM + binary heads.
+
+Capability match of the reference's standalone test BERT
+(reference: apex/transformer/testing/standalone_bert.py, 217 LoC on the
+Megatron toolkit): vocab-parallel embeddings (word + position +
+tokentype), tensor-parallel encoder layers with padding-mask attention,
+a tied-embedding masked-LM head and a binary (NSP/SOP) head.  Shares the
+scanned-layer design of :class:`~apex_tpu.models.gpt.GPTModel`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.ops.attention import mha_reference
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+from apex_tpu.transformer.parallel_state import (
+    DATA_PARALLEL_AXIS,
+    TENSOR_PARALLEL_AXIS,
+)
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    vocab_parallel_cross_entropy,
+)
+
+__all__ = ["BertConfig", "BertModel"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 32000
+    num_layers: int = 4
+    hidden_size: int = 512
+    num_attention_heads: int = 8
+    max_position_embeddings: int = 512
+    num_tokentypes: int = 2
+    ffn_hidden_size: Optional[int] = None
+    layernorm_epsilon: float = 1e-5
+    init_method_std: float = 0.02
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat: bool = True
+    add_binary_head: bool = True
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            self.ffn_hidden_size = 4 * self.hidden_size
+        if self.hidden_size % self.num_attention_heads:
+            raise ValueError(
+                "hidden_size must be divisible by num_attention_heads"
+            )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def _normal(std):
+    def init(key, shape, dtype):
+        return std * jax.random.normal(key, shape, dtype)
+
+    return init
+
+
+class BertModel:
+    """Encoder LM over a tp-sharded mesh (factory convention:
+    init / param_specs / apply / loss)."""
+
+    def __init__(self, config: BertConfig, axis_name: str = TENSOR_PARALLEL_AXIS):
+        self.config = config
+        self.axis_name = axis_name
+        c = config
+        init = _normal(c.init_method_std)
+        out_init = _normal(c.init_method_std / (2.0 * c.num_layers) ** 0.5)
+        self.embedding = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, init_method=init,
+            params_dtype=c.params_dtype, axis_name=axis_name,
+        )
+        self.qkv = ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, gather_output=False,
+            init_method=init, params_dtype=c.params_dtype,
+            axis_name=axis_name,
+        )
+        self.attn_proj = RowParallelLinear(
+            c.hidden_size, c.hidden_size, input_is_parallel=True,
+            init_method=out_init, params_dtype=c.params_dtype,
+            axis_name=axis_name,
+        )
+        self.fc1 = ColumnParallelLinear(
+            c.hidden_size, c.ffn_hidden_size, gather_output=False,
+            init_method=init, params_dtype=c.params_dtype,
+            axis_name=axis_name,
+        )
+        self.fc2 = RowParallelLinear(
+            c.ffn_hidden_size, c.hidden_size, input_is_parallel=True,
+            init_method=out_init, params_dtype=c.params_dtype,
+            axis_name=axis_name,
+        )
+
+    # ---------------------------------------------------------------- init
+    def _ln(self):
+        c = self.config
+        return {
+            "scale": jnp.ones((c.hidden_size,), c.params_dtype),
+            "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
+        }
+
+    def _init_one_layer(self, key) -> Dict[str, Any]:
+        ks = jax.random.split(key, 4)
+        return {
+            "ln1": self._ln(),
+            "qkv": self.qkv.init(ks[0]),
+            "attn_proj": self.attn_proj.init(ks[1]),
+            "ln2": self._ln(),
+            "fc1": self.fc1.init(ks[2]),
+            "fc2": self.fc2.init(ks[3]),
+        }
+
+    def init(self, key) -> Dict[str, Any]:
+        c = self.config
+        ks = jax.random.split(key, 7)
+        layers = jax.vmap(self._init_one_layer)(
+            jax.random.split(ks[2], c.num_layers)
+        )
+        init = _normal(c.init_method_std)
+        params = {
+            "embedding": self.embedding.init(ks[0]),
+            "pos_embedding": init(
+                ks[1], (c.max_position_embeddings, c.hidden_size),
+                c.params_dtype,
+            ),
+            "tokentype_embedding": init(
+                ks[3], (c.num_tokentypes, c.hidden_size), c.params_dtype
+            ),
+            "layers": layers,
+            "final_ln": self._ln(),
+            # MLM head: dense + LN + tied-embedding logits + bias
+            "lm_head": {
+                "dense": {
+                    "weight": init(
+                        ks[4], (c.hidden_size, c.hidden_size), c.params_dtype
+                    ),
+                    "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
+                },
+                "ln": self._ln(),
+                # vocab-sharded output bias, like the reference's
+                # parallel lm-logits bias
+                "bias": jnp.zeros((c.vocab_size,), c.params_dtype),
+            },
+        }
+        if c.add_binary_head:
+            params["pooler"] = {
+                "weight": init(
+                    ks[5], (c.hidden_size, c.hidden_size), c.params_dtype
+                ),
+                "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
+            }
+            params["binary_head"] = {
+                "weight": init(ks[6], (c.hidden_size, 2), c.params_dtype),
+                "bias": jnp.zeros((2,), c.params_dtype),
+            }
+        return params
+
+    def param_specs(self) -> Dict[str, Any]:
+        c = self.config
+        rep = {"scale": P(), "bias": P()}
+        layer = {
+            "ln1": rep,
+            "qkv": self.qkv.param_specs(),
+            "attn_proj": self.attn_proj.param_specs(),
+            "ln2": rep,
+            "fc1": self.fc1.param_specs(),
+            "fc2": self.fc2.param_specs(),
+        }
+        stacked = jax.tree.map(
+            lambda s: P(None, *s), layer, is_leaf=lambda x: isinstance(x, P)
+        )
+        specs = {
+            "embedding": self.embedding.param_specs(),
+            "pos_embedding": P(),
+            "tokentype_embedding": P(),
+            "layers": stacked,
+            "final_ln": dict(rep),
+            "lm_head": {
+                "dense": {"weight": P(), "bias": P()},
+                "ln": dict(rep),
+                "bias": P(self.axis_name),
+            },
+        }
+        if c.add_binary_head:
+            specs["pooler"] = {"weight": P(), "bias": P()}
+            specs["binary_head"] = {"weight": P(), "bias": P()}
+        return specs
+
+    # ------------------------------------------------------------- forward
+    def _layer(self, lp, x, bias):
+        c = self.config
+        world = jax.lax.axis_size(self.axis_name)
+        heads_local = c.num_attention_heads // world
+        b, s, h = x.shape
+
+        residual = x
+        y = fused_layer_norm_affine(
+            x, lp["ln1"]["scale"], lp["ln1"]["bias"], (h,),
+            eps=c.layernorm_epsilon,
+        ).astype(c.compute_dtype)
+        qkv = self.qkv.apply(lp["qkv"], y)
+        qkv = qkv.reshape(b, s, heads_local, 3, c.head_dim)
+        q, k, v = (
+            jnp.moveaxis(qkv[:, :, :, i], 2, 1) for i in range(3)
+        )
+        attn = mha_reference(q, k, v, causal=False, bias=bias)
+        attn = jnp.moveaxis(attn, 1, 2).reshape(b, s, heads_local * c.head_dim)
+        out = self.attn_proj.apply(lp["attn_proj"], attn)
+        x = residual + out.astype(residual.dtype)
+
+        residual = x
+        y = fused_layer_norm_affine(
+            x, lp["ln2"]["scale"], lp["ln2"]["bias"], (h,),
+            eps=c.layernorm_epsilon,
+        ).astype(c.compute_dtype)
+        y = self.fc1.apply(lp["fc1"], y)
+        y = jax.nn.gelu(y, approximate=True)
+        y = self.fc2.apply(lp["fc2"], y)
+        return residual + y.astype(residual.dtype)
+
+    def encode(
+        self,
+        params: Dict[str, Any],
+        tokens: jnp.ndarray,
+        attention_mask: Optional[jnp.ndarray] = None,
+        tokentype_ids: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """tokens (b, s); attention_mask (b, s) True=keep.  Returns
+        (b, s, h) final-layernormed hidden states."""
+        c = self.config
+        b, s = tokens.shape
+        x = self.embedding.apply(params["embedding"], tokens)
+        x = x + params["pos_embedding"][:s][None].astype(x.dtype)
+        if tokentype_ids is not None:
+            x = x + jnp.take(
+                params["tokentype_embedding"], tokentype_ids, axis=0
+            ).astype(x.dtype)
+        x = x.astype(c.compute_dtype)
+
+        bias = None
+        if attention_mask is not None:
+            bias = jnp.where(attention_mask, 0.0, -1e30)[:, None, None, :]
+
+        def body(carry, lp):
+            return self._layer(lp, carry, bias), None
+
+        scan_body = body
+        if c.remat:
+            from apex_tpu.transformer.tensor_parallel.random import checkpoint
+
+            scan_body = checkpoint(body)
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        x = fused_layer_norm_affine(
+            x.astype(jnp.float32),
+            params["final_ln"]["scale"], params["final_ln"]["bias"],
+            (c.hidden_size,), eps=c.layernorm_epsilon,
+        )
+        return x.astype(c.compute_dtype)
+
+    def lm_logits(self, params, hidden) -> jnp.ndarray:
+        """MLM head → vocab-parallel logits (b, s, vocab/tp)."""
+        c = self.config
+        hd = params["lm_head"]
+        h = jnp.matmul(hidden, hd["dense"]["weight"].astype(hidden.dtype))
+        h = jax.nn.gelu(
+            h + hd["dense"]["bias"].astype(h.dtype), approximate=True
+        )
+        h = fused_layer_norm_affine(
+            h.astype(jnp.float32), hd["ln"]["scale"], hd["ln"]["bias"],
+            (c.hidden_size,), eps=c.layernorm_epsilon,
+        ).astype(hidden.dtype)
+        w = params["embedding"]["weight"].astype(h.dtype)  # (vocab/tp, h)
+        logits = jnp.einsum("bsh,vh->bsv", h, w)
+        return logits + hd["bias"].astype(logits.dtype)
+
+    def binary_logits(self, params, hidden) -> jnp.ndarray:
+        """Pooled [CLS] → 2-way head (reference: NSP/SOP head)."""
+        pooled = jnp.tanh(
+            hidden[:, 0] @ params["pooler"]["weight"].astype(hidden.dtype)
+            + params["pooler"]["bias"].astype(hidden.dtype)
+        )
+        return (
+            pooled @ params["binary_head"]["weight"].astype(pooled.dtype)
+            + params["binary_head"]["bias"].astype(pooled.dtype)
+        ).astype(jnp.float32)
+
+    def apply(self, params, tokens, attention_mask=None, tokentype_ids=None):
+        hidden = self.encode(params, tokens, attention_mask, tokentype_ids)
+        lm = self.lm_logits(params, hidden)
+        if self.config.add_binary_head:
+            return lm, self.binary_logits(params, hidden)
+        return lm, None
+
+    def loss(
+        self,
+        params: Dict[str, Any],
+        tokens: jnp.ndarray,
+        lm_labels: jnp.ndarray,
+        loss_mask: jnp.ndarray,
+        attention_mask: Optional[jnp.ndarray] = None,
+        binary_labels: Optional[jnp.ndarray] = None,
+        tokentype_ids: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """Masked-LM CE averaged over masked positions (+ binary CE),
+        pmean over dp (reference: standalone BERT's loss_func)."""
+        lm, binary = self.apply(params, tokens, attention_mask, tokentype_ids)
+        per_token = vocab_parallel_cross_entropy(
+            lm, lm_labels, axis_name=self.axis_name
+        )
+        mask = loss_mask.astype(jnp.float32)
+        # global masked mean: psum numerator and denominator separately —
+        # a pmean of per-shard ratios would weight shards with different
+        # mask counts unequally
+        num = jax.lax.psum(jnp.sum(per_token * mask), DATA_PARALLEL_AXIS)
+        den = jax.lax.psum(jnp.sum(mask), DATA_PARALLEL_AXIS)
+        loss = num / jnp.maximum(den, 1.0)
+        if binary is not None and binary_labels is not None:
+            logp = jax.nn.log_softmax(binary, axis=-1)
+            sop = -jnp.mean(
+                jnp.take_along_axis(logp, binary_labels[:, None], 1)[:, 0]
+            )
+            loss = loss + jax.lax.pmean(sop, DATA_PARALLEL_AXIS)
+        return loss

@@ -29,6 +29,7 @@ from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
 )
 from apex_tpu.transformer.pipeline_parallel.schedules import (
     forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
     get_forward_backward_func,
     pipeline,
@@ -40,6 +41,7 @@ __all__ = [
     "sync_replicated_grads",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
     "get_forward_backward_func",
     "send_forward",
     "send_backward",

@@ -45,9 +45,25 @@ __all__ = [
     "pipeline",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
     "get_forward_backward_func",
 ]
 
+
+
+def _ensure_varying(tree: Any, axis_name: str) -> Any:
+    """pcast to varying over ``axis_name`` only where not already so —
+    pcast rejects a no-op cast."""
+
+    def cast(x):
+        try:
+            if axis_name in jax.typeof(x).vma:
+                return x
+        except Exception:
+            pass
+        return lax.pcast(x, axis_name, to="varying")
+
+    return jax.tree.map(cast, tree)
 
 def _index_microbatch(microbatches: Any, i) -> Any:
     return jax.tree.map(
@@ -97,9 +113,8 @@ def pipeline(
     # varying-across-mesh axes: derive it from a real entry activation
     # (multiply-by-zero keeps the vma) and mark it varying over the
     # pipeline axis, which ppermute introduces inside the loop
-    zeros_state = jax.tree.map(
-        lambda a: lax.pcast(a * 0, axis_name, to="varying"),
-        first_fn(mb0),
+    zeros_state = _ensure_varying(
+        jax.tree.map(lambda a: a * 0, first_fn(mb0)), axis_name
     )
 
     body = stage_fn
@@ -175,6 +190,92 @@ def forward_backward_pipelining_without_interleaving(
     )
 
 
+def forward_backward_pipelining_with_interleaving(
+    first_fn: Callable,
+    chunk_fn: Callable,
+    last_fn: Callable,
+    microbatches: Any,
+    num_model_chunks: int,
+    *,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Interleaved (virtual-pipeline) schedule, compiled
+    (reference: fwd_bwd_pipelining_with_interleaving.py:22-308).
+
+    Each rank holds ``num_model_chunks`` model chunks; chunk v of rank p
+    is global stage ``v*pp + p``, and a microbatch rides the ring V
+    times.  One tick = one *chunk* application per rank, so the fill
+    bubble is ``(pp-1)`` chunk-times — V× smaller than the
+    non-interleaved schedule's, which is the entire point of virtual
+    pipelining.  Groups of ``pp`` microbatches cycle in flight;
+    ``num_microbatches`` must divide by pp (same restriction as the
+    reference, fwd_bwd_pipelining_with_interleaving.py asserts it).
+
+    - ``chunk_fn(x, v)``: apply model chunk ``v`` (a traced index —
+      select chunk params with ``lax.dynamic_index_in_dim``).
+    - ``first_fn`` / ``last_fn`` / ``microbatches`` as in
+      :func:`pipeline`.
+    Returns per-microbatch ``last_fn`` results, replicated over pp.
+    """
+    pp = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    V = num_model_chunks
+    num_micro = jax.tree.leaves(microbatches)[0].shape[0]
+    if num_micro % pp:
+        raise ValueError(
+            f"number of microbatches ({num_micro}) is not divisible by "
+            f"pipeline-parallel size ({pp}) as required by the "
+            "interleaved schedule"
+        )
+    ticks = num_micro * V + pp - 1
+
+    mb0 = _index_microbatch(microbatches, 0)
+    zeros_state = _ensure_varying(
+        jax.tree.map(lambda a: a * 0, first_fn(mb0)), axis_name
+    )
+
+    body = chunk_fn
+    if remat:
+        body = jax.checkpoint(chunk_fn)
+
+    def tick(carry, t):
+        state, acc = carry
+        # schedule coordinates: rank p at tick t handles microbatch
+        # g*pp + m on chunk v, where t - p = g*(V*pp) + v*pp + m
+        tau = t - rank
+        phase = jnp.maximum(tau, 0)
+        m = phase % pp
+        v = (phase % (V * pp)) // pp
+        g = phase // (V * pp)
+        mb = g * pp + m
+        mb_c = jnp.clip(mb, 0, num_micro - 1)
+        mb_in = _index_microbatch(microbatches, mb_c)
+
+        entry = first_fn(mb_in)
+        is_entry = (rank == 0) & (v == 0)
+        x = _where_tree(is_entry, entry, state)
+        y = body(x, v)
+
+        is_exit = (rank == pp - 1) & (v == V - 1) & (tau >= 0) & (
+            mb < num_micro
+        )
+        r = last_fn(y, mb_in)
+        r = jnp.where(is_exit, r, jnp.zeros_like(r))
+        acc = acc.at[mb_c].add(r)
+
+        state = send_forward(y, axis_name)
+        return (state, acc), None
+
+    r0 = last_fn(zeros_state, mb0)  # shape/dtype/vma probe
+    acc0 = _ensure_varying(
+        jnp.zeros((num_micro,) + r0.shape, r0.dtype) + r0 * 0, axis_name
+    )
+    (_, acc), _ = lax.scan(tick, (zeros_state, acc0), jnp.arange(ticks))
+    # only the exit stage accumulated real values
+    return lax.psum(acc, axis_name)
+
+
 def get_forward_backward_func(
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     pipeline_model_parallel_size: int = 1,
@@ -182,9 +283,6 @@ def get_forward_backward_func(
     """(reference: schedules/__init__.py:1-39)"""
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None:
-            raise NotImplementedError(
-                "interleaved virtual-pipeline schedule is not implemented "
-                "yet; use the non-interleaved compiled pipeline"
-            )
+            return forward_backward_pipelining_with_interleaving
         return forward_backward_pipelining_without_interleaving
     return forward_backward_no_pipelining

@@ -1,0 +1,134 @@
+"""BERT model tests (the reference's run_bert_minimal_test analog)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.models.bert import BertConfig, BertModel
+from apex_tpu.transformer import parallel_state
+
+
+def small_config(**kw):
+    base = dict(
+        vocab_size=64, num_layers=2, hidden_size=32, num_attention_heads=4,
+        max_position_embeddings=16, compute_dtype=jnp.float32, remat=False,
+    )
+    base.update(kw)
+    return BertConfig(**base)
+
+
+def make_batch(key, b=8, s=12, vocab=64):
+    ks = jax.random.split(key, 5)
+    return dict(
+        tokens=jax.random.randint(ks[0], (b, s), 0, vocab),
+        lm_labels=jax.random.randint(ks[1], (b, s), 0, vocab),
+        loss_mask=jax.random.bernoulli(ks[2], 0.15, (b, s)),
+        attention_mask=jnp.ones((b, s), bool).at[:, -2:].set(False),
+        binary_labels=jax.random.randint(ks[3], (b,), 0, 2),
+        tokentype_ids=jax.random.randint(ks[4], (b, s), 0, 2),
+    )
+
+
+def run_loss(tp, batch, remat=False):
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=tp
+    )
+    try:
+        model = BertModel(small_config(remat=remat))
+        params = model.init(jax.random.PRNGKey(0))
+        specs = model.param_specs()
+
+        def loss_fn(p, tokens, lm_labels, loss_mask, attention_mask,
+                    binary_labels, tokentype_ids):
+            return model.loss(p, tokens, lm_labels, loss_mask,
+                              attention_mask, binary_labels, tokentype_ids)
+
+        fn = jax.jit(
+            jax.shard_map(
+                jax.value_and_grad(loss_fn),
+                mesh=mesh,
+                in_specs=(specs,) + (P("dp"),) * 6,
+                out_specs=(P(), specs),
+            )
+        )
+        placed = jax.device_put(
+            params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        )
+        loss, grads = fn(
+            placed, batch["tokens"], batch["lm_labels"], batch["loss_mask"],
+            batch["attention_mask"], batch["binary_labels"],
+            batch["tokentype_ids"],
+        )
+        return float(loss), jax.device_get(grads)
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_bert_loss_tp_invariant():
+    batch = make_batch(jax.random.PRNGKey(1))
+    loss1, grads1 = run_loss(1, batch)
+    loss4, grads4 = run_loss(4, batch)
+    assert np.isfinite(loss1)
+    np.testing.assert_allclose(loss4, loss1, rtol=2e-4)
+    for (ka, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(grads4),
+        jax.tree_util.tree_leaves_with_path(grads1),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=1e-5,
+            err_msg=str(ka),
+        )
+
+
+def test_bert_attention_mask_blocks_padding():
+    """Changing a masked-out token must not change other positions'
+    hidden states."""
+    mesh = parallel_state.initialize_model_parallel()
+    try:
+        model = BertModel(small_config())
+        params = model.init(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 12), 0, 64)
+        mask = jnp.ones((8, 12), bool).at[:, 10:].set(False)
+
+        specs = model.param_specs()
+        fn = jax.jit(
+            jax.shard_map(
+                lambda p, t, m: model.encode(p, t, m),
+                mesh=mesh,
+                in_specs=(specs, P("dp"), P("dp")),
+                out_specs=P("dp"),
+            )
+        )
+        a = fn(params, tokens, mask)
+        tokens2 = tokens.at[:, 11].set(0)
+        b = fn(params, tokens2, mask)
+        np.testing.assert_allclose(
+            np.asarray(a[:, :10]), np.asarray(b[:, :10]), atol=1e-5
+        )
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_bert_without_binary_head():
+    mesh = parallel_state.initialize_model_parallel()
+    try:
+        model = BertModel(small_config(add_binary_head=False))
+        params = model.init(jax.random.PRNGKey(0))
+        assert "binary_head" not in params
+        specs = model.param_specs()
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 12), 0, 64)
+        fn = jax.jit(
+            jax.shard_map(
+                lambda p, t: model.apply(p, t)[0],
+                mesh=mesh,
+                in_specs=(specs, P("dp")),
+                out_specs=P("dp", None, "tp"),
+            )
+        )
+        lm = fn(params, tokens)
+        assert lm.shape == (8, 12, 64)
+    finally:
+        parallel_state.destroy_model_parallel()

@@ -108,6 +108,134 @@ def test_pipeline_matches_serial(remat):
         parallel_state.destroy_model_parallel()
 
 
+@pytest.mark.parametrize("num_chunks", [2, 4])
+def test_interleaved_pipeline_matches_serial(num_chunks):
+    """pp=4 x V chunks circular schedule == serial dense math, fwd+grads.
+    Layers are assigned chunk-major: chunk v holds layers
+    [v*pp*Lc + p*Lc, ...) — i.e. the stacked dim is reshaped
+    (V, pp, Lc) so global stage v*pp+p gets its contiguous slice."""
+    pp = 4
+    per_chunk = 2 if num_chunks == 2 else 1
+    NUM_L = pp * num_chunks * per_chunk
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4
+    )
+    try:
+        kw, kb = jax.random.split(jax.random.PRNGKey(0))
+        params = {
+            "w": 0.3 * jax.random.normal(kw, (NUM_L, HIDDEN, HIDDEN)),
+            "b": 0.01 * jax.random.normal(kb, (NUM_L, HIDDEN)),
+        }
+
+        def serial(params, x, y):
+            h = x
+            for l in range(NUM_L):
+                h = jnp.tanh(h @ params["w"][l] + params["b"][l])
+            return jnp.mean((h - y) ** 2)
+
+        # chunk-major layout: (L,) → (V, pp, per_chunk) → shard dim 1
+        def to_stages(p):
+            return jax.tree.map(
+                lambda a: a.reshape(
+                    (num_chunks, pp, per_chunk) + a.shape[1:]
+                ),
+                p,
+            )
+
+        stage_specs = {
+            "w": P(None, "pp", None, None, None),
+            "b": P(None, "pp", None, None),
+        }
+        dp = mesh.shape["dp"]
+        x = jax.random.normal(jax.random.PRNGKey(1), (MICRO * MB * dp, HIDDEN))
+        y = jax.random.normal(jax.random.PRNGKey(2), (MICRO * MB * dp, HIDDEN))
+
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_pipelining_with_interleaving,
+        )
+
+        def pp_loss(sp, x, y):
+            # sp leaves: (V, 1, per_chunk, ...) local → (V, per_chunk, ...)
+            sp = jax.tree.map(lambda a: a[:, 0], sp)
+            mbs = {
+                "x": x.reshape(MICRO, MB, HIDDEN),
+                "y": y.reshape(MICRO, MB, HIDDEN),
+            }
+
+            def chunk_fn(h, v):
+                lp = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, v, 0, keepdims=False
+                    ),
+                    sp,
+                )
+                return _stage_scan(lp, h)
+
+            per_micro = forward_backward_pipelining_with_interleaving(
+                first_fn=lambda mb: mb["x"],
+                chunk_fn=chunk_fn,
+                last_fn=lambda h, mb: jnp.mean((h - mb["y"]) ** 2),
+                microbatches=mbs,
+                num_model_chunks=num_chunks,
+            )
+            return jax.lax.pmean(jnp.mean(per_micro), "dp")
+
+        grad_fn = jax.jit(
+            jax.shard_map(
+                jax.value_and_grad(pp_loss),
+                mesh=mesh,
+                in_specs=(stage_specs, P("dp"), P("dp")),
+                out_specs=(P(), stage_specs),
+            )
+        )
+        staged = to_stages(params)
+        placed = jax.device_put(
+            staged,
+            jax.tree.map(lambda s: NamedSharding(mesh, s), stage_specs,
+                         is_leaf=lambda x: isinstance(x, P)),
+        )
+        loss, grads = grad_fn(placed, x, y)
+        ref_loss, ref_grads = jax.value_and_grad(serial)(params, x, y)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        got = jax.tree.map(
+            lambda a: np.asarray(a).reshape((NUM_L,) + a.shape[3:]),
+            jax.device_get(grads),
+        )
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(a, np.asarray(b), rtol=1e-4,
+                                       atol=1e-6)
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_interleaved_requires_divisible_microbatches():
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4
+    )
+    try:
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_pipelining_with_interleaving,
+        )
+
+        def run(x):
+            return forward_backward_pipelining_with_interleaving(
+                first_fn=lambda mb: mb,
+                chunk_fn=lambda h, v: h,
+                last_fn=lambda h, mb: jnp.mean(h),
+                microbatches=x,
+                num_model_chunks=2,
+            )
+
+        with pytest.raises(ValueError, match="not divisible"):
+            jax.jit(
+                jax.shard_map(
+                    run, mesh=mesh, in_specs=(P(),), out_specs=P()
+                )
+            )(jnp.ones((6, 2, HIDDEN)))  # 6 % 4 != 0
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
 def test_no_pipelining_matches_serial():
     mesh = parallel_state.initialize_model_parallel()
     try:
@@ -157,8 +285,14 @@ def test_get_forward_backward_func_dispatch():
     assert (
         get_forward_backward_func(None, 1) is forward_backward_no_pipelining
     )
-    with pytest.raises(NotImplementedError):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_with_interleaving,
+    )
+
+    assert (
         get_forward_backward_func(2, 4)
+        is forward_backward_pipelining_with_interleaving
+    )
 
 
 class TestMicrobatchCalculators:
