@@ -1,0 +1,162 @@
+"""Native runtime bindings — the ``apex_C`` extension analog.
+
+Compiles :file:`apex_c.cpp` on demand with g++ (cached under
+``_build/``) and exposes it through ctypes over numpy buffers.  Falls
+back to pure-numpy implementations when no toolchain is available, the
+same graceful degradation the reference applies when its extensions
+aren't built (reference: apex/parallel/distributed.py:13-23).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "flatten",
+    "unflatten",
+    "plan_buckets",
+    "native_available",
+]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_HERE, "_build")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        so = os.path.join(_BUILD, "libapex_c.so")
+        src = os.path.join(_HERE, "apex_c.cpp")
+        try:
+            if not os.path.exists(so) or (
+                os.path.getmtime(so) < os.path.getmtime(src)
+            ):
+                os.makedirs(_BUILD, exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", src, "-o", so],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(so)
+            lib.apex_c_flatten.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32,
+            ]
+            lib.apex_c_unflatten.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.c_int32,
+            ]
+            lib.apex_c_plan_buckets.argtypes = [
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.apex_c_plan_buckets.restype = ctypes.c_int64
+            _LIB = lib
+        except Exception:
+            _LIB = None
+        return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _as_contig(arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+    return [np.ascontiguousarray(a) for a in arrays]
+
+
+def flatten(arrays: Sequence[np.ndarray], threads: int = 8) -> np.ndarray:
+    """Concatenate host arrays byte-wise into one uint8 buffer
+    (reference: ``apex_C.flatten``, csrc/flatten_unflatten.cpp:15)."""
+    arrays = _as_contig(arrays)
+    nbytes = [a.nbytes for a in arrays]
+    out = np.empty(sum(nbytes), np.uint8)
+    lib = _load()
+    if lib is None or not arrays:
+        off = 0
+        for a, nb in zip(arrays, nbytes):
+            out[off : off + nb] = a.view(np.uint8).reshape(-1)
+            off += nb
+        return out
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays]
+    )
+    sizes = (ctypes.c_int64 * n)(*nbytes)
+    lib.apex_c_flatten(
+        srcs, sizes, n, out.ctypes.data_as(ctypes.c_void_p), threads
+    )
+    return out
+
+
+def unflatten(
+    flat: np.ndarray,
+    shapes: Sequence[Tuple[int, ...]],
+    dtypes: Sequence[np.dtype],
+    threads: int = 8,
+) -> List[np.ndarray]:
+    """Split a flat uint8 buffer back into arrays
+    (reference: ``apex_C.unflatten``)."""
+    flat = np.ascontiguousarray(flat.view(np.uint8).reshape(-1))
+    outs = [np.empty(s, d) for s, d in zip(shapes, dtypes)]
+    nbytes = [o.nbytes for o in outs]
+    if sum(nbytes) != flat.nbytes:
+        raise ValueError(
+            f"flat buffer has {flat.nbytes} bytes but shapes/dtypes "
+            f"describe {sum(nbytes)}"
+        )
+    lib = _load()
+    if lib is None or not outs:
+        off = 0
+        for o, nb in zip(outs, nbytes):
+            o.view(np.uint8).reshape(-1)[:] = flat[off : off + nb]
+            off += nb
+        return outs
+    n = len(outs)
+    dsts = (ctypes.c_void_p * n)(
+        *[o.ctypes.data_as(ctypes.c_void_p).value for o in outs]
+    )
+    sizes = (ctypes.c_int64 * n)(*nbytes)
+    lib.apex_c_unflatten(
+        flat.ctypes.data_as(ctypes.c_void_p), dsts, sizes, n, threads
+    )
+    return outs
+
+
+def plan_buckets(nbytes: Sequence[int], cap_bytes: int) -> np.ndarray:
+    """Greedy size-capped bucket assignment — the host-side analog of
+    DDP's bucket-structure discovery (reference:
+    apex/parallel/distributed.py:364-395).  Returns int32 bucket ids."""
+    n = len(nbytes)
+    ids = np.empty(n, np.int32)
+    lib = _load()
+    if lib is None:
+        bucket = used = 0
+        for i, nb in enumerate(nbytes):
+            if used > 0 and used + nb > cap_bytes:
+                bucket += 1
+                used = 0
+            ids[i] = bucket
+            used += nb
+        return ids
+    arr = (ctypes.c_int64 * n)(*nbytes)
+    lib.apex_c_plan_buckets(
+        arr, n, cap_bytes, ids.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int32)
+        )
+    )
+    return ids
