@@ -1,0 +1,52 @@
+"""Ambient-precision bridge for the fused modules.
+
+Capability match of ``apex/_autocast_utils.py:1-17``
+(``_cast_if_autocast_enabled``): every reference fused module casts its
+inputs when ``torch.cuda.amp.autocast`` is active, so fused ops compose
+with native amp.  The JAX analog is an explicit, thread-local compute
+dtype that :func:`autocast` installs and
+:func:`_cast_if_autocast_enabled` consults — no global tracer state is
+touched, and jit-traced functions capture the mode at trace time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["autocast", "get_autocast_dtype", "_cast_if_autocast_enabled"]
+
+_STATE = threading.local()
+
+
+def get_autocast_dtype() -> Optional[Any]:
+    return getattr(_STATE, "dtype", None)
+
+
+@contextlib.contextmanager
+def autocast(dtype: Any = jnp.bfloat16, enabled: bool = True):
+    """``with apex_tpu._autocast_utils.autocast():`` — fused modules
+    called under this context cast float inputs to ``dtype``."""
+    prev = get_autocast_dtype()
+    _STATE.dtype = dtype if enabled else None
+    try:
+        yield
+    finally:
+        _STATE.dtype = prev
+
+
+def _cast_if_autocast_enabled(*args: Any) -> Sequence[Any]:
+    """(reference: apex/_autocast_utils.py ``_cast_if_autocast_enabled``)"""
+    dtype = get_autocast_dtype()
+    if dtype is None:
+        return args
+    return tuple(
+        a.astype(dtype)
+        if isinstance(a, jnp.ndarray) and jnp.issubdtype(a.dtype, jnp.floating)
+        else a
+        for a in args
+    )

@@ -1,0 +1,48 @@
+"""Activation memory buffers.
+
+Capability note, not a port: the reference pre-allocates a flat device
+buffer that activation checkpointing carves chunks out of to avoid
+allocator churn (reference: apex/transformer/tensor_parallel/memory.py:
+34-136 ``GlobalMemoryBuffer``/``RingMemBuffer``).  Under XLA all device
+buffers inside a jitted step are planned statically by the compiler —
+there is no runtime allocator to churn — so the device-side capability
+is inherent.  What remains useful on TPU hosts is staging-buffer reuse
+for the input pipeline, which this module provides.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+__all__ = ["GlobalMemoryBuffer", "RingMemBuffer"]
+
+
+class GlobalMemoryBuffer:
+    """Reusable host staging buffers keyed by (shape, dtype)
+    (host-side analog of reference memory.py:34-77)."""
+
+    def __init__(self):
+        self.buffer: Dict[Tuple, np.ndarray] = {}
+
+    def get_tensor(self, shape, dtype, name: str) -> np.ndarray:
+        key = (name, tuple(shape), np.dtype(dtype).name)
+        buf = self.buffer.get(key)
+        if buf is None:
+            buf = np.empty(shape, dtype)
+            self.buffer[key] = buf
+        return buf
+
+
+class RingMemBuffer:
+    """N-buffer ring (reference memory.py:120-136) — lets the input
+    pipeline fill buffer k+1 while buffer k is still being transferred."""
+
+    def __init__(self, name: str, num_buffers: int, shape, dtype):
+        self.buffers = [np.empty(shape, dtype) for _ in range(num_buffers)]
+        self._idx = -1
+
+    def get_next_buffer(self) -> np.ndarray:
+        self._idx = (self._idx + 1) % len(self.buffers)
+        return self.buffers[self._idx]
