@@ -5,9 +5,10 @@ The reference monkey-patches torch namespaces against whitelists
 apex/amp/wrap.py:10-85 cast wrappers; cast lists in apex/amp/lists/).
 JAX functions can't be patched behind the tracer's back — and don't need
 to be: these decorators wrap *your* functions at definition site with
-the same semantics (cast array args to the target dtype, run, return),
-and a registry records them so a policy sweep can flip the low-precision
-dtype globally (fp16 ↔ bf16, the O1 ↔ O4 switch).
+the same semantics (cast array args to the target dtype, run, return).
+``half_function`` wrappers read the process-global low-precision dtype
+at call time, so :func:`set_low_precision_dtype` flips every one of them
+between fp16 and bf16 (the O1 ↔ O4 switch).
 """
 
 from __future__ import annotations
@@ -31,7 +32,6 @@ __all__ = [
 
 # the process-global low-precision dtype; O1 uses fp16, O4 bf16
 _LOW_PRECISION: Dict[str, Any] = {"dtype": jnp.bfloat16}
-_REGISTRY: Dict[str, Callable] = {}
 
 
 def set_low_precision_dtype(dtype) -> None:
@@ -64,9 +64,7 @@ def half_function(fn: Callable) -> Callable:
     """Run in the low-precision dtype (reference: amp.py ``half_function``;
     fp16 under O1, bf16 under O4 — controlled by
     :func:`set_low_precision_dtype`)."""
-    wrapped = _wrap(fn, lambda: _LOW_PRECISION["dtype"])
-    _REGISTRY[getattr(fn, "__name__", repr(fn))] = wrapped
-    return wrapped
+    return _wrap(fn, lambda: _LOW_PRECISION["dtype"])
 
 
 def bfloat16_function(fn: Callable) -> Callable:

@@ -56,7 +56,8 @@ def save(path: str, tree: Any) -> None:
     flat, treedef = jax.tree_util.tree_flatten(jax.device_get(tree))
     arrays = [np.asarray(l) for l in flat]
     manifest = {
-        "treedef": str(treedef),
+        # human-readable only; restore() reads treedef.pkl
+        "treedef_repr": str(treedef),
         "leaves": [
             {"shape": list(a.shape), "dtype": a.dtype.name} for a in arrays
         ],
@@ -64,12 +65,11 @@ def save(path: str, tree: Any) -> None:
     blob = csrc.flatten(arrays)
     with open(os.path.join(path, _DATA), "wb") as f:
         f.write(blob.tobytes())
-    # keep an executable spec of the treedef: round-trip via example tree
-    manifest["structure"] = jax.tree_util.tree_structure(tree).num_leaves
     with open(os.path.join(path, _MANIFEST), "w") as f:
         json.dump(manifest, f)
-    # store the treedef itself with pickle-free reconstruction: write an
-    # index pytree whose leaves are leaf positions
+    # the structure itself is pickled; this couples a checkpoint to the
+    # jax treedef format, so restore with a `target` tree when loading
+    # checkpoints across jax upgrades
     import pickle
 
     with open(os.path.join(path, "treedef.pkl"), "wb") as f:

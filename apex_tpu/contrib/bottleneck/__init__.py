@@ -15,48 +15,27 @@ Layout: NHWC (TPU-native; the reference also prefers channels-last).
 
 from __future__ import annotations
 
-import math
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
+from apex_tpu.utils.convnet import conv_nhwc as _conv, he_init as _he
+
 __all__ = ["Bottleneck", "SpatialBottleneck", "halo_exchange"]
 
 
-def _conv(x, w, stride=1, padding="SAME"):
-    return lax.conv_general_dilated(
-        x, w.astype(x.dtype),
-        window_strides=(stride, stride),
-        padding=padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
-
-
 def _bn(x, scale, bias, eps=1e-5, axis_name=None):
-    """Per-batch BN; with ``axis_name`` the (n, Σx, Σx²) stats are
-    psum-ed over that mesh axis so an H-sharded block normalizes exactly
-    like its dense counterpart."""
-    xf = x.astype(jnp.float32)
-    n = jnp.float32(xf.size // xf.shape[-1])
-    s = jnp.sum(xf, axis=(0, 1, 2))
-    sq = jnp.sum(jnp.square(xf), axis=(0, 1, 2))
-    if axis_name is not None:
-        n = lax.psum(n, axis_name)
-        s = lax.psum(s, axis_name)
-        sq = lax.psum(sq, axis_name)
-    mean = s / n
-    var = sq / n - jnp.square(mean)
-    out = (xf - mean) * lax.rsqrt(var + eps)
-    return (out * scale.astype(jnp.float32)
-            + bias.astype(jnp.float32)).astype(x.dtype)
-
-
-def _he(key, shape, dtype):
-    fan_in = shape[0] * shape[1] * shape[2]
-    std = math.sqrt(2.0 / fan_in)
-    return std * jax.random.normal(key, shape, dtype)
+    """Per-batch BN via the shared SyncBN math; with ``axis_name`` the
+    stats are psum-ed over that mesh axis so an H-sharded block
+    normalizes exactly like its dense counterpart."""
+    out, _, _ = sync_batch_norm(
+        x, scale, bias, None, None, training=True, eps=eps,
+        axis_name=axis_name,
+    )
+    return out
 
 
 class Bottleneck:

@@ -79,6 +79,11 @@ def _as_contig(arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
     return [np.ascontiguousarray(a) for a in arrays]
 
 
+def _bytes_view(a: np.ndarray) -> np.ndarray:
+    # atleast_1d: a 0-d array cannot be viewed as uint8
+    return np.atleast_1d(a).view(np.uint8).reshape(-1)
+
+
 def flatten(arrays: Sequence[np.ndarray], threads: int = 8) -> np.ndarray:
     """Concatenate host arrays byte-wise into one uint8 buffer
     (reference: ``apex_C.flatten``, csrc/flatten_unflatten.cpp:15)."""
@@ -89,7 +94,7 @@ def flatten(arrays: Sequence[np.ndarray], threads: int = 8) -> np.ndarray:
     if lib is None or not arrays:
         off = 0
         for a, nb in zip(arrays, nbytes):
-            out[off : off + nb] = a.view(np.uint8).reshape(-1)
+            out[off : off + nb] = _bytes_view(a)
             off += nb
         return out
     n = len(arrays)
@@ -123,7 +128,7 @@ def unflatten(
     if lib is None or not outs:
         off = 0
         for o, nb in zip(outs, nbytes):
-            o.view(np.uint8).reshape(-1)[:] = flat[off : off + nb]
+            _bytes_view(o)[:] = flat[off : off + nb]
             off += nb
         return outs
     n = len(outs)

@@ -20,6 +20,7 @@ from jax import lax
 
 from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
 from apex_tpu.transformer.parallel_state import DATA_PARALLEL_AXIS
+from apex_tpu.utils.convnet import conv_nhwc, he_init
 
 __all__ = ["ResNetConfig", "ResNet", "resnet50"]
 
@@ -50,16 +51,8 @@ class ResNetConfig:
         self.stage_blocks, self.bottleneck = _DEPTHS[self.depth]
 
 
-def _he(key, shape, dtype):
-    fan_in = shape[0] * shape[1] * shape[2]
-    return math.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)
-
-
-def _conv(x, w, stride=1):
-    return lax.conv_general_dilated(
-        x, w.astype(x.dtype), (stride, stride), "SAME",
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
+_he = he_init
+_conv = conv_nhwc
 
 
 class ResNet:

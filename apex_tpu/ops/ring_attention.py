@@ -62,31 +62,31 @@ def ring_attention(
     q32 = q.astype(jnp.float32) * scale
     qpos = rank * s_local + jnp.arange(s_local)
 
-    def block(carry, i):
-        k_blk, v_blk, acc, m, l = carry
+    def attend(i, k_blk, v_blk, acc, m, l):
         src = (rank - i) % cp  # whose K/V shard we currently hold
         kpos = src * s_local + jnp.arange(s_local)
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            s = jnp.where(kpos[None, None, None, :] >
+                          qpos[None, None, :, None], _NEG, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
 
-        def attend(k_blk, v_blk, acc, m, l):
-            s = jnp.einsum(
-                "bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32),
-                preferred_element_type=jnp.float32,
-            )
-            if causal:
-                s = jnp.where(kpos[None, None, None, :] >
-                              qpos[None, None, :, None], _NEG, s)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            corr = jnp.exp(m - m_new)
-            l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-            acc_new = acc * corr + jnp.einsum(
-                "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32),
-                preferred_element_type=jnp.float32,
-            )
-            return acc_new, m_new, l_new
+    attend_fn = jax.checkpoint(attend) if remat else attend
 
-        fn = jax.checkpoint(attend) if remat else attend
-        acc, m, l = fn(k_blk, v_blk, acc, m, l)
+    def block(carry, i):
+        k_blk, v_blk, acc, m, l = carry
+        acc, m, l = attend_fn(i, k_blk, v_blk, acc, m, l)
         # rotate K/V one step around the ring
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
@@ -99,10 +99,14 @@ def ring_attention(
     acc0 = zero_q
     m0 = jnp.sum(zero_q, axis=-1, keepdims=True) + _NEG
     l0 = jnp.sum(zero_q, axis=-1, keepdims=True)
-    (k_fin, v_fin, acc, m, l), _ = lax.scan(
-        block, (k, v, acc0, m0, l0), jnp.arange(cp)
+    # scan the first cp-1 blocks (each ends with a rotation), then attend
+    # the final block outside the loop — a rotation there would only
+    # carry K/V back to where they started, and XLA cannot DCE a
+    # collective inside the loop body
+    (k_last, v_last, acc, m, l), _ = lax.scan(
+        block, (k, v, acc0, m0, l0), jnp.arange(cp - 1)
     )
-    del k_fin, v_fin  # back where they started after cp rotations
+    acc, m, l = attend_fn(cp - 1, k_last, v_last, acc, m, l)
     out = acc / jnp.maximum(l, 1e-30)
     return out.astype(q.dtype)
 

@@ -280,9 +280,20 @@ def get_forward_backward_func(
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     pipeline_model_parallel_size: int = 1,
 ):
-    """(reference: schedules/__init__.py:1-39)"""
+    """(reference: schedules/__init__.py:1-39)
+
+    All three returned callables share the signature
+    ``fn(first_fn, stage_fn, last_fn, microbatches, **kw)`` — the
+    interleaved case has ``num_model_chunks`` pre-bound, and its
+    ``stage_fn`` is called as ``stage_fn(x, chunk_idx)`` (select chunk
+    params with ``lax.dynamic_index_in_dim``)."""
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None:
-            return forward_backward_pipelining_with_interleaving
+            import functools
+
+            return functools.partial(
+                forward_backward_pipelining_with_interleaving,
+                num_model_chunks=virtual_pipeline_model_parallel_size,
+            )
         return forward_backward_pipelining_without_interleaving
     return forward_backward_no_pipelining

@@ -1,0 +1,27 @@
+"""Shared convnet building blocks (NHWC conv, He init) used by the
+ResNet family and the contrib bottleneck blocks."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax import lax
+
+__all__ = ["conv_nhwc", "he_init"]
+
+
+def conv_nhwc(x, w, stride: int = 1, padding="SAME"):
+    """2-D conv in the TPU-native NHWC/HWIO layout."""
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def he_init(key, shape, dtype):
+    """Kaiming-normal init for HWIO conv weights."""
+    fan_in = shape[0] * shape[1] * shape[2]
+    return math.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)

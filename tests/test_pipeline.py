@@ -289,10 +289,9 @@ def test_get_forward_backward_func_dispatch():
         forward_backward_pipelining_with_interleaving,
     )
 
-    assert (
-        get_forward_backward_func(2, 4)
-        is forward_backward_pipelining_with_interleaving
-    )
+    interleaved = get_forward_backward_func(2, 4)
+    assert interleaved.func is forward_backward_pipelining_with_interleaving
+    assert interleaved.keywords == {"num_model_chunks": 2}
 
 
 class TestMicrobatchCalculators:
