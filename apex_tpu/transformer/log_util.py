@@ -1,0 +1,19 @@
+"""Rank-annotated transformer loggers
+(reference: apex/transformer/log_util.py:1-19)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["get_transformer_logger", "set_logging_level"]
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    name_wo_ext = os.path.splitext(name)[0]
+    return logging.getLogger(f"apex_tpu.transformer.{name_wo_ext}")
+
+
+def set_logging_level(verbosity) -> None:
+    """(reference: log_util.py ``set_logging_level``)"""
+    logging.getLogger("apex_tpu.transformer").setLevel(verbosity)

@@ -1,0 +1,163 @@
+"""Mixture-of-experts with expert parallelism (EP).
+
+Beyond-reference capability: SURVEY.md §2.3 records expert parallelism
+as **absent** from the reference snapshot.  TPU-native design:
+
+- Switch-style top-1 routing with a fixed per-(expert, source-rank)
+  capacity — static shapes, so the whole layer jits;
+- experts sharded over an **expert-parallel mesh axis** (default "dp",
+  the usual Megatron choice: expert weights ride the data-parallel
+  ranks); tokens travel to their expert's rank and back with two
+  ``lax.all_to_all`` collectives over ICI;
+- the ffn dim of each expert is additionally **tensor-parallel** over
+  "tp" (column-then-row pattern with a psum, exactly like the dense
+  MLP);
+- gradients need no special handling: expert params are ep-varying in
+  shard_map's vma type system, so autodiff yields per-expert grads while
+  replicated router grads come back already summed across dp.
+
+Returns the Switch auxiliary load-balance loss alongside the output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer.parallel_state import (
+    DATA_PARALLEL_AXIS,
+    TENSOR_PARALLEL_AXIS,
+)
+
+__all__ = ["MoEMLP"]
+
+
+class MoEMLP:
+    """Expert-parallel Switch MLP.
+
+    ``num_experts`` must divide by the expert-parallel axis size; each
+    rank hosts ``num_experts/ep`` experts.  ``capacity_factor`` scales
+    the per-(expert, source-rank) token budget; overflow tokens are
+    dropped (their output is zero — the caller's residual carries them),
+    the standard Switch behaviour.
+    """
+
+    def __init__(
+        self,
+        hidden_size: int,
+        ffn_hidden_size: int,
+        num_experts: int,
+        *,
+        capacity_factor: float = 1.25,
+        ep_axis: str = DATA_PARALLEL_AXIS,
+        tp_axis: str = TENSOR_PARALLEL_AXIS,
+        params_dtype: Any = jnp.float32,
+        init_std: float = 0.02,
+    ):
+        self.hidden_size = hidden_size
+        self.ffn_hidden_size = ffn_hidden_size
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.ep_axis = ep_axis
+        self.tp_axis = tp_axis
+        self.params_dtype = params_dtype
+        self.init_std = init_std
+
+    def init(self, key) -> Dict[str, Any]:
+        k1, k2, k3 = jax.random.split(key, 3)
+        std = self.init_std
+        return {
+            "router": {
+                "weight": std * jax.random.normal(
+                    k1, (self.hidden_size, self.num_experts),
+                    self.params_dtype,
+                )
+            },
+            "w1": std * jax.random.normal(
+                k2,
+                (self.num_experts, self.hidden_size, self.ffn_hidden_size),
+                self.params_dtype,
+            ),
+            "w2": std * jax.random.normal(
+                k3,
+                (self.num_experts, self.ffn_hidden_size, self.hidden_size),
+                self.params_dtype,
+            ),
+        }
+
+    def param_specs(self) -> Dict[str, Any]:
+        return {
+            "router": {"weight": P()},
+            "w1": P(self.ep_axis, None, self.tp_axis),
+            "w2": P(self.ep_axis, self.tp_axis, None),
+        }
+
+    def apply(
+        self, params: Dict[str, Any], x: jnp.ndarray
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """x: (b, s, h) local tokens — call inside shard_map.  Returns
+        (output (b, s, h), aux load-balance loss scalar)."""
+        b, s, h = x.shape
+        n = b * s
+        E = self.num_experts
+        ep = lax.axis_size(self.ep_axis)
+        e_local = E // ep
+        cap = max(1, int(self.capacity_factor * n / E))
+
+        flat = x.reshape(n, h)
+        logits = jnp.matmul(
+            flat.astype(jnp.float32),
+            params["router"]["weight"].astype(jnp.float32),
+        )
+        probs = jax.nn.softmax(logits, axis=-1)          # (n, E)
+        gate = jnp.max(probs, axis=-1)                   # (n,)
+        expert_idx = jnp.argmax(probs, axis=-1)          # (n,)
+
+        # Switch aux loss: E * Σ_e (fraction routed to e)·(mean prob of e)
+        one_hot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
+        frac = jnp.mean(one_hot, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        aux = E * jnp.sum(frac * mean_prob)
+
+        # position of each token within its expert's capacity buffer
+        pos = jnp.cumsum(one_hot, axis=0) * one_hot      # (n, E)
+        pos = jnp.sum(pos, axis=-1).astype(jnp.int32) - 1
+        keep = pos < cap
+        weight = jnp.where(keep, gate, 0.0).astype(x.dtype)
+
+        # dispatch buffers: (E, cap, h), one slot per routed token
+        dispatch = jnp.zeros((E, cap, h), x.dtype)
+        safe_pos = jnp.where(keep, pos, 0)
+        dispatch = dispatch.at[expert_idx, safe_pos].add(
+            flat * keep[:, None].astype(x.dtype)
+        )
+
+        # tokens → expert ranks: tiled all_to_all over the expert dim.
+        # received block i holds source-rank i's tokens for MY experts
+        recv = lax.all_to_all(
+            dispatch, self.ep_axis, split_axis=0, concat_axis=0, tiled=True
+        )                                                # (ep*e_local, cap, h)
+        recv = recv.reshape(ep, e_local, cap, h)
+        recv = jnp.moveaxis(recv, 0, 1).reshape(e_local, ep * cap, h)
+
+        # local experts, ffn dim tensor-parallel (column then row + psum)
+        w1 = params["w1"].astype(x.dtype)                # (e_local, h, f/tp)
+        w2 = params["w2"].astype(x.dtype)                # (e_local, f/tp, h)
+        h1 = jnp.einsum("ech,ehf->ecf", recv, w1)
+        h1 = jax.nn.gelu(h1, approximate=True)
+        h2 = jnp.einsum("ecf,efh->ech", h1, w2)
+        h2 = lax.psum(h2, self.tp_axis)
+
+        # expert ranks → tokens: inverse all_to_all
+        back = h2.reshape(e_local, ep, cap, h)
+        back = jnp.moveaxis(back, 1, 0).reshape(ep * e_local, cap, h)
+        combined = lax.all_to_all(
+            back, self.ep_axis, split_axis=0, concat_axis=0, tiled=True
+        )                                                # (E, cap, h)
+
+        out = combined[expert_idx, safe_pos] * weight[:, None]
+        return out.reshape(b, s, h), aux

@@ -1,0 +1,123 @@
+"""Expert-parallel MoE tests: ep-sharded == dense, routing behaviour."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.moe import MoEMLP
+
+H, F, E = 16, 32, 8
+N = 32  # global tokens (b=8, s=4)
+
+
+def build(mesh, layer):
+    specs = layer.param_specs()
+
+    def fwd(params, x):
+        out, aux = layer.apply(params, x)
+        return out, jax.lax.pmean(aux, "dp")
+
+    fn = jax.jit(
+        jax.shard_map(
+            fwd, mesh=mesh,
+            in_specs=(specs, P("dp")),
+            out_specs=(P("dp"), P()),
+        )
+    )
+    return fn, specs
+
+
+def place(mesh, tree, specs):
+    return jax.device_put(
+        tree, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                           is_leaf=lambda x: isinstance(x, P))
+    )
+
+
+def test_ep_matches_dense():
+    """ep=8-sharded MoE == the same params applied densely, when the
+    capacity is large enough that nothing drops."""
+    layer = MoEMLP(H, F, E, capacity_factor=float(E))  # no drops
+    params = layer.init(jax.random.PRNGKey(0))
+    x = 0.5 * jax.random.normal(jax.random.PRNGKey(1), (8, 4, H))
+
+    # dense: dp=1 mesh (cp soaks up the devices)
+    mesh = parallel_state.initialize_model_parallel(context_parallel_size_=8)
+    try:
+        fn, specs = build(mesh, layer)
+        ref, ref_aux = fn(params, x)
+        ref, ref_aux = np.asarray(ref), float(ref_aux)
+    finally:
+        parallel_state.destroy_model_parallel()
+
+    # expert-parallel: dp=8, experts sharded across ranks
+    mesh = parallel_state.initialize_model_parallel()
+    try:
+        fn, specs = build(mesh, layer)
+        placed = place(mesh, params, specs)
+        out, aux = fn(placed, x)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                                   atol=1e-5)
+        # aux loss is per-shard routing stats; just sanity it
+        assert np.isfinite(float(aux))
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_capacity_drops_tokens():
+    """With a tiny capacity most tokens get zero output (residual path)."""
+    mesh = parallel_state.initialize_model_parallel(context_parallel_size_=8)
+    try:
+        layer = MoEMLP(H, F, E, capacity_factor=0.25)
+        params = layer.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, H))
+        fn, specs = build(mesh, layer)
+        out, _ = fn(params, x)
+        flat = np.asarray(out).reshape(-1, H)
+        zero_rows = np.sum(np.all(flat == 0, axis=-1))
+        assert zero_rows > 0  # overflow tokens dropped
+        assert zero_rows < flat.shape[0]  # but not all
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_moe_trains_and_grads_are_per_expert():
+    """End-to-end: grads flow, expert grads differ across ep ranks, and
+    a few SGD steps reduce the loss."""
+    mesh = parallel_state.initialize_model_parallel()
+    try:
+        layer = MoEMLP(H, F, E, capacity_factor=8.0)
+        params = layer.init(jax.random.PRNGKey(0))
+        specs = layer.param_specs()
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, H))
+        y = jax.random.normal(jax.random.PRNGKey(2), (8, 4, H))
+
+        def loss_fn(params, x, y):
+            out, aux = layer.apply(params, x)
+            mse = jnp.mean((out - y) ** 2)
+            return jax.lax.pmean(mse, "dp") + 0.01 * jax.lax.pmean(aux, "dp")
+
+        step = jax.jit(
+            jax.shard_map(
+                lambda p, x, y: jax.value_and_grad(loss_fn)(p, x, y),
+                mesh=mesh,
+                in_specs=(specs, P("dp"), P("dp")),
+                out_specs=(P(), specs),
+            )
+        )
+        placed = place(mesh, params, specs)
+        losses = []
+        for _ in range(200):
+            loss, grads = step(placed, x, y)
+            losses.append(float(loss))
+            placed = jax.tree.map(lambda p, g: p - 1.0 * g, placed, grads)
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0] * 0.9
+        # expert grads are ep-sharded arrays of global shape (E, ...)
+        g_w1 = grads["w1"]
+        assert g_w1.shape == (E, H, F)
+    finally:
+        parallel_state.destroy_model_parallel()
