@@ -1,0 +1,87 @@
+"""Auto-resume: periodic checkpoints + latest-state recovery.
+
+The reference only stubs this capability — a ``_GLOBAL_AUTORESUME``
+placeholder (reference: apex/transformer/pipeline_parallel/utils.py:34)
+and overflow skip-steps; actual save/resume lives in example scripts.
+Here it is a real subsystem built on :mod:`apex_tpu.checkpoint`:
+
+- :class:`AutoResume` saves the full train state every
+  ``interval_steps`` and on SIGTERM (preemption notice), keeps the last
+  ``keep`` checkpoints, and resumes from the newest one at startup;
+- state is anything pytree-shaped: params, optimizer state, amp
+  state-dicts, data-iterator counters.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+from typing import Any, Optional, Tuple
+
+from apex_tpu import checkpoint as ckpt
+
+__all__ = ["AutoResume"]
+
+
+class AutoResume:
+    def __init__(
+        self,
+        root: str,
+        interval_steps: int = 1000,
+        keep: int = 2,
+        install_sigterm_handler: bool = False,
+    ):
+        self.root = root
+        self.interval_steps = interval_steps
+        self.keep = keep
+        self._termination_requested = False
+        if install_sigterm_handler:
+            signal.signal(signal.SIGTERM, self._on_sigterm)
+
+    # ------------------------------------------------------------ resume
+    def resume(self, target: Optional[Any] = None) -> Tuple[Optional[Any], int]:
+        """Returns (state, step) of the newest checkpoint, or
+        (None, 0) when starting fresh."""
+        step = ckpt.latest_step(self.root)
+        if step is None:
+            return None, 0
+        return ckpt.restore_step(self.root, target=target, step=step), step
+
+    # -------------------------------------------------------------- save
+    def _gc(self) -> None:
+        steps = sorted(
+            int(d.split("_", 1)[1])
+            for d in os.listdir(self.root)
+            if d.startswith("step_")
+        )
+        for old in steps[: -self.keep]:
+            shutil.rmtree(
+                os.path.join(self.root, f"step_{old}"), ignore_errors=True
+            )
+
+    def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Save when the interval elapses or termination was requested.
+        Returns True if a checkpoint was written."""
+        due = force or self._termination_requested or (
+            step > 0 and step % self.interval_steps == 0
+        )
+        if not due:
+            return False
+        ckpt.save_step(self.root, step, state)
+        self._gc()
+        return True
+
+    # --------------------------------------------------- failure signal
+    def _on_sigterm(self, signum, frame):
+        # mark only; the training loop saves at the next step boundary
+        # (async-safe: no I/O in the handler)
+        self._termination_requested = True
+
+    def termination_requested(self) -> bool:
+        """(the reference's AutoResume.termination_requested() shape,
+        as used by Megatron-style training loops)"""
+        return self._termination_requested
+
+    def request_termination(self) -> None:
+        self._termination_requested = True

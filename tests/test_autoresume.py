@@ -1,0 +1,46 @@
+"""AutoResume subsystem tests."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from apex_tpu.utils.autoresume import AutoResume
+
+
+def test_fresh_start_then_resume(tmp_path):
+    root = str(tmp_path / "run")
+    ar = AutoResume(root, interval_steps=5, keep=2)
+    state, step = ar.resume()
+    assert state is None and step == 0
+
+    # simulate a training loop
+    for step in range(1, 13):
+        state = {"w": jnp.full((3,), float(step)), "step": jnp.int32(step)}
+        ar.maybe_save(step, state)
+
+    # saved at 5 and 10; keep=2 → both present
+    ar2 = AutoResume(root, interval_steps=5, keep=2)
+    state, step = ar2.resume()
+    assert step == 10
+    np.testing.assert_array_equal(np.asarray(state["w"]), 10.0)
+
+
+def test_gc_keeps_last_n(tmp_path):
+    root = str(tmp_path / "run")
+    ar = AutoResume(root, interval_steps=1, keep=2)
+    for step in range(1, 6):
+        ar.maybe_save(step, {"w": jnp.zeros(2)})
+    import os
+
+    dirs = sorted(os.listdir(root))
+    assert dirs == ["step_4", "step_5"]
+
+
+def test_termination_request_forces_save(tmp_path):
+    root = str(tmp_path / "run")
+    ar = AutoResume(root, interval_steps=1000, keep=1)
+    assert not ar.maybe_save(3, {"w": jnp.zeros(2)})
+    ar.request_termination()
+    assert ar.termination_requested()
+    assert ar.maybe_save(4, {"w": jnp.zeros(2)})
+    _, step = AutoResume(root).resume()
+    assert step == 4
