@@ -45,6 +45,7 @@ def ring_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     remat: bool = True,
+    block_k: int = 512,
 ) -> jnp.ndarray:
     """Attention over the global sequence from per-rank shards.
 
@@ -52,35 +53,57 @@ def ring_attention(
     contiguous shard of a sequence of length ``cp * s_local``.  Call
     inside ``shard_map`` with the sequence dim sharded over ``axis_name``.
     Returns the local shard of the attention output.
+
+    ``block_k`` chunks the inner K walk of each ring step so peak score
+    memory is (s_local × block_k), not (s_local × s_local) — the
+    flash-attention trade, expressed in XLA, which keeps long-context
+    shards (s_local ≫ 1k) inside VMEM-friendly working sets.
     """
     b, h, s_local, d = q.shape
     scale = (1.0 / d**0.5) if sm_scale is None else float(sm_scale)
     cp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
+    bk = min(block_k, s_local)
+    if s_local % bk:
+        bk = s_local  # irregular shard: fall back to one chunk
+    n_chunks = s_local // bk
 
     q32 = q.astype(jnp.float32) * scale
     qpos = rank * s_local + jnp.arange(s_local)
 
     def attend(i, k_blk, v_blk, acc, m, l):
         src = (rank - i) % cp  # whose K/V shard we currently hold
-        kpos = src * s_local + jnp.arange(s_local)
-        s = jnp.einsum(
-            "bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-        if causal:
-            s = jnp.where(kpos[None, None, None, :] >
-                          qpos[None, None, :, None], _NEG, s)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-        return acc_new, m_new, l_new
+
+        def kchunk(carry, j):
+            acc, m, l = carry
+            kc = lax.dynamic_slice_in_dim(k_blk, j * bk, bk, axis=2)
+            vc = lax.dynamic_slice_in_dim(v_blk, j * bk, bk, axis=2)
+            kpos = src * s_local + j * bk + jnp.arange(bk)
+            s = jnp.einsum(
+                "bhqd,bhkd->bhqk", q32, kc.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            if causal:
+                s = jnp.where(kpos[None, None, None, :] >
+                              qpos[None, None, :, None], _NEG, s)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * corr + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            return (acc_new, m_new, l_new), None
+
+        if n_chunks == 1:
+            (acc, m, l), _ = kchunk((acc, m, l), 0)
+        else:
+            (acc, m, l), _ = lax.scan(
+                kchunk, (acc, m, l), jnp.arange(n_chunks)
+            )
+        return acc, m, l
 
     attend_fn = jax.checkpoint(attend) if remat else attend
 

@@ -45,6 +45,26 @@ def test_ring_matches_dense(mesh, causal):
     )
 
 
+@pytest.mark.parametrize("block_k", [2, 4, 8])
+def test_ring_inner_chunking_matches(mesh, block_k):
+    """The block_k inner K walk must not change the math."""
+    q, k, v = qkv(jax.random.PRNGKey(3))
+    ref = mha_reference(q, k, v, causal=True)
+    fn = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention(
+                q, k, v, causal=True, block_k=block_k
+            ),
+            mesh=mesh,
+            in_specs=(P(None, None, "cp"),) * 3,
+            out_specs=P(None, None, "cp"),
+        )
+    )
+    np.testing.assert_allclose(
+        np.asarray(fn(q, k, v)), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
+
+
 @pytest.mark.parametrize("remat", [False, True])
 def test_ring_grads_match_dense(mesh, remat):
     q, k, v = qkv(jax.random.PRNGKey(1))
