@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from apex_tpu.ops.common import shape_struct
 
-from apex_tpu.utils.platform import supports_pallas
+from apex_tpu.utils.platform import default_implementation, is_tpu
 
 __all__ = [
     "fused_layer_norm",
@@ -101,6 +101,8 @@ def _ln_fwd_pallas(x2d: jnp.ndarray, eps: float, rms: bool):
             shape_struct((1, padded_rows), jnp.float32, x2d),
             shape_struct((1, padded_rows), jnp.float32, x2d),
         ],
+        # interpreter mode off-TPU so the kernel body stays testable
+        interpret=not is_tpu(),
     )(x2d)
     mean, invvar = mean[0], invvar[0]
     if pad:
@@ -122,7 +124,7 @@ def _ln_fwd_xla(x2d: jnp.ndarray, eps: float, rms: bool):
 
 
 def _ln_fwd(x2d, eps, rms, implementation: Optional[str]):
-    impl = implementation or ("pallas" if supports_pallas() else "xla")
+    impl = implementation or default_implementation()
     if impl == "pallas":
         try:
             return _ln_fwd_pallas(x2d, eps, rms)
