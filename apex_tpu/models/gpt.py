@@ -80,6 +80,13 @@ class GPTConfig:
     # attention — long-context training (new capability vs the reference,
     # SURVEY.md §2.3); tokens then arrive as the local (b, s/cp) shard
     context_parallel: bool = False
+    # Mixture-of-Experts: replace every dense MLP block with an
+    # expert-parallel Switch MLP of this many experts (None = dense).
+    # Experts shard over "dp"; the Switch aux loss is added to the LM
+    # loss with moe_aux_weight.
+    num_experts: Optional[int] = None
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if self.ffn_hidden_size is None:
@@ -159,6 +166,19 @@ class GPTModel:
             params_dtype=c.params_dtype,
             axis_name=axis_name,
         )
+        self.moe = None
+        if c.num_experts is not None:
+            from apex_tpu.transformer.moe import MoEMLP
+
+            self.moe = MoEMLP(
+                c.hidden_size,
+                c.ffn_hidden_size,
+                c.num_experts,
+                capacity_factor=c.moe_capacity_factor,
+                tp_axis=axis_name,
+                params_dtype=c.params_dtype,
+                init_std=c.init_method_std,
+            )
 
     # ---------------------------------------------------------------- init
     def _init_one_layer(self, key) -> Dict[str, Any]:
@@ -168,14 +188,18 @@ class GPTModel:
             "scale": jnp.ones((c.hidden_size,), c.params_dtype),
             "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
         }
-        return {
+        layer = {
             "ln1": ln(),
             "qkv": self.qkv.init(keys[0]),
             "attn_proj": self.attn_proj.init(keys[1]),
             "ln2": ln(),
-            "fc1": self.fc1.init(keys[2]),
-            "fc2": self.fc2.init(keys[3]),
         }
+        if self.moe is not None:
+            layer["moe"] = self.moe.init(keys[2])
+        else:
+            layer["fc1"] = self.fc1.init(keys[2])
+            layer["fc2"] = self.fc2.init(keys[3])
+        return layer
 
     def init(self, key) -> Dict[str, Any]:
         c = self.config
@@ -202,9 +226,12 @@ class GPTModel:
             "qkv": self.qkv.param_specs(),
             "attn_proj": self.attn_proj.param_specs(),
             "ln2": rep,
-            "fc1": self.fc1.param_specs(),
-            "fc2": self.fc2.param_specs(),
         }
+        if self.moe is not None:
+            layer["moe"] = self.moe.param_specs()
+        else:
+            layer["fc1"] = self.fc1.param_specs()
+            layer["fc2"] = self.fc2.param_specs()
         # prepend the stacked-layer dim (replicated) to each layer spec
         stacked = jax.tree.map(
             lambda s: P(None, *s), layer, is_leaf=lambda x: isinstance(x, P)
@@ -282,19 +309,23 @@ class GPTModel:
             out = jnp.where(keep, out / (1.0 - c.hidden_dropout), 0.0)
         x = residual + out.astype(residual.dtype)
 
-        # -- MLP block -------------------------------------------------
+        # -- MLP block (dense or expert-parallel MoE) -------------------
         residual = x
         y = fused_layer_norm_affine(
             x, lp["ln2"]["scale"], lp["ln2"]["bias"], (h,), eps=c.layernorm_epsilon
         ).astype(c.compute_dtype)
-        y = self.fc1.apply(lp["fc1"], y)
-        y = jax.nn.gelu(y, approximate=True)
-        y = self.fc2.apply(lp["fc2"], y)
+        if self.moe is not None:
+            y, aux = self.moe.apply(lp["moe"], y)
+        else:
+            y = self.fc1.apply(lp["fc1"], y)
+            y = jax.nn.gelu(y, approximate=True)
+            y = self.fc2.apply(lp["fc2"], y)
+            aux = jnp.float32(0.0)
         if c.hidden_dropout > 0.0 and key is not None:
             hkey = data_parallel_key(jax.random.fold_in(key, 2))
             keep = jax.random.bernoulli(hkey, 1.0 - c.hidden_dropout, y.shape)
             y = jnp.where(keep, y / (1.0 - c.hidden_dropout), 0.0)
-        return residual + y.astype(residual.dtype)
+        return residual + y.astype(residual.dtype), aux
 
     def hidden_states(
         self,
@@ -303,7 +334,8 @@ class GPTModel:
         rng: Optional[jax.Array] = None,
     ) -> jnp.ndarray:
         """Embed + run all layers + final layernorm. tokens: (b, s) local
-        (dp-sharded) batch; returns (b, s, h) in compute dtype."""
+        (dp-sharded) batch; returns ((b, s, h) hidden in compute dtype,
+        summed MoE aux loss — 0.0 for dense models)."""
         c = self.config
         b, s = tokens.shape
         x = self.embedding.apply(params["embedding"], tokens)
@@ -327,7 +359,8 @@ class GPTModel:
 
         def body(carry, scanned):
             lp, key = scanned
-            return self._layer(lp, carry, key if use_rng else None), None
+            out, aux = self._layer(lp, carry, key if use_rng else None)
+            return out, aux
 
         if c.remat:
             from apex_tpu.transformer.tensor_parallel.random import checkpoint
@@ -340,7 +373,7 @@ class GPTModel:
             # dummy keys keep the scanned-pytree structure static
             else jnp.zeros((c.num_layers, 2), jnp.uint32)
         )
-        x, _ = jax.lax.scan(body, x, (params["layers"], keys))
+        x, aux = jax.lax.scan(body, x, (params["layers"], keys))
 
         x = fused_layer_norm_affine(
             x.astype(jnp.float32),
@@ -349,7 +382,7 @@ class GPTModel:
             (c.hidden_size,),
             eps=c.layernorm_epsilon,
         )
-        return x.astype(c.compute_dtype)
+        return x.astype(c.compute_dtype), jnp.sum(aux)
 
     def logits(self, params: Dict[str, Any], hidden: jnp.ndarray) -> jnp.ndarray:
         """Tied-embedding LM head → vocab-parallel logits (b, s, vocab/tp)
@@ -364,7 +397,8 @@ class GPTModel:
         rng: Optional[jax.Array] = None,
     ) -> jnp.ndarray:
         """Forward to vocab-parallel logits — call inside shard_map."""
-        return self.logits(params, self.hidden_states(params, tokens, rng))
+        hidden, _ = self.hidden_states(params, tokens, rng)
+        return self.logits(params, hidden)
 
     def loss(
         self,
@@ -375,11 +409,14 @@ class GPTModel:
     ) -> jnp.ndarray:
         """Mean next-token CE over the local batch; psum-mean over dp so
         every device returns the same scalar."""
-        logits = self.apply(params, tokens, rng)
+        hidden, aux = self.hidden_states(params, tokens, rng)
+        logits = self.logits(params, hidden)
         per_token = vocab_parallel_cross_entropy(
             logits, targets, axis_name=self.axis_name
         )
         loss = jnp.mean(per_token)
+        if self.moe is not None:
+            loss = loss + self.config.moe_aux_weight * aux
         loss = jax.lax.pmean(loss, DATA_PARALLEL_AXIS)
         if self.config.context_parallel:
             from apex_tpu.transformer.parallel_state import (
@@ -435,8 +472,10 @@ class GPTModel:
             return x.astype(c.compute_dtype)
 
         def stage_fn(x):
+            # MoE aux loss is not accumulated through the pipeline path
             def body(h, lp):
-                return self._layer(lp, h, None), None
+                out, _aux = self._layer(lp, h, None)
+                return out, None
 
             out, _ = jax.lax.scan(body, x, params["layers"])
             return out
