@@ -1,126 +1,478 @@
-"""Benchmark: flagship GPT training-step throughput on one TPU chip.
+"""Benchmark: flagship GPT training-step throughput (+ MFU) on one chip.
 
-Prints ONE JSON line:
+Prints ONE JSON line on stdout:
   {"metric": "gpt_tp1_tokens_per_sec", "value": N, "unit": "tokens/s",
-   "vs_baseline": R}
+   "vs_baseline": R, ...extra diagnostic fields...}
 
 ``vs_baseline`` is the speedup of the framework's fast path (bf16 compute
 + flash attention + fused master-weight Adam — the amp-O5 analog) over an
 O0-analog baseline measured in the same run (fp32 compute, XLA attention,
 same optimizer math).  The reference publishes no numeric baselines
 (BASELINE.md), so the baseline is measured, not copied.
+
+Resilience (the round-1 bench died at backend init with no retry and no
+diagnostics): this file is an orchestrator that runs the measurement in
+bounded subprocesses — a TPU-tunnel hang cannot eat the whole bench — and
+retries backend init with backoff.  If the TPU stays unreachable it falls
+back to a CPU measurement (clearly marked) and ALWAYS emits a valid JSON
+line, never a bare traceback.
+
+Extra BASELINE.md targets (RN50-style images/sec, FusedLAMB step time vs
+an unfused per-tensor LAMB with identical math) are also measured —
+platform-marked, scaled down on the CPU fallback — and written to
+BENCH_EXTRA.json + stderr, keeping stdout a single line.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from apex_tpu.models import GPTConfig, GPTModel
-from apex_tpu.optimizers import FusedAdam
-from apex_tpu.transformer import parallel_state
-from apex_tpu.transformer.tensor_parallel.layers import state_specs_like
-
-BATCH = 8
-SEQ = 1024
-WARMUP = 2
-STEPS = 10
+PROBE_TIMEOUT = int(os.environ.get("APEX_BENCH_PROBE_TIMEOUT", "180"))
+CHILD_TIMEOUT = int(os.environ.get("APEX_BENCH_CHILD_TIMEOUT", "1200"))
+TOTAL_BUDGET = int(os.environ.get("APEX_BENCH_TOTAL_BUDGET", "3000"))
+RETRIES = int(os.environ.get("APEX_BENCH_RETRIES", "3"))
+BACKOFF = [15, 45, 90]
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def build_step(fast: bool):
-    if parallel_state.model_parallel_is_initialized():
-        parallel_state.destroy_model_parallel()
-    mesh = parallel_state.initialize_model_parallel()
-    cfg = GPTConfig(
-        vocab_size=32768,
-        num_layers=12,
-        hidden_size=1024,
-        num_attention_heads=8,  # head_dim 128 = one MXU lane tile
-        max_position_embeddings=SEQ,
-        compute_dtype=jnp.bfloat16 if fast else jnp.float32,
-        attention_impl=None if fast else "xla",
-        remat=True,
+# --------------------------------------------------------------------- child
+def _pin_cpu():
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _peak_flops(device):
+    """Per-chip peak bf16 FLOP/s by device kind (public spec sheets)."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = [
+        ("v6", 918e12),
+        ("v5p", 459e12),
+        ("v5", 197e12),  # v5e / v5 lite
+        ("v4", 275e12),
+        ("v3", 123e12),
+        ("v2", 46e12),
+    ]
+    for key, peak in table:
+        if key in kind:
+            return peak
+    return None
+
+
+def child_probe():
+    import jax
+
+    d = jax.devices()[0]
+    print(json.dumps({
+        "platform": d.platform,
+        "device_kind": getattr(d, "device_kind", ""),
+        "n": len(jax.devices()),
+    }))
+
+
+def child_gpt(platform: str):
+    if platform == "cpu":
+        _pin_cpu()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.tensor_parallel.layers import state_specs_like
+
+    on_tpu = platform != "cpu"
+    # CPU fallback uses a small config so the bench finishes on a 1-core
+    # host; the TPU config is the real measurement
+    cfg_common = dict(
+        vocab_size=32768 if on_tpu else 4096,
+        num_layers=12 if on_tpu else 2,
+        hidden_size=1024 if on_tpu else 256,
+        num_attention_heads=8 if on_tpu else 4,
     )
-    model = GPTModel(cfg)
-    params = model.init(jax.random.PRNGKey(0))
-    specs = model.param_specs()
-    opt = FusedAdam(lr=1e-4, master_weights=fast)
+    BATCH = 8 if on_tpu else 2
+    SEQ = 1024 if on_tpu else 256
+    WARMUP = 2
+    STEPS = 10 if on_tpu else 4
+
+    def build_step(fast: bool):
+        if parallel_state.model_parallel_is_initialized():
+            parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel()
+        cfg = GPTConfig(
+            max_position_embeddings=SEQ,
+            compute_dtype=jnp.bfloat16 if fast else jnp.float32,
+            attention_impl=(None if on_tpu else "xla") if fast else "xla",
+            remat=True,
+            **cfg_common,
+        )
+        model = GPTModel(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        specs = model.param_specs()
+        opt = FusedAdam(lr=1e-4, master_weights=fast)
+        opt_state = opt.init(params)
+        opt_specs = state_specs_like(specs, opt_state)
+
+        def train_step(params, opt_state, tokens, targets):
+            loss, grads = jax.value_and_grad(model.loss)(
+                params, tokens, targets
+            )
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+            new_params, new_opt = opt.step(opt_state, grads, params)
+            return new_params, new_opt, loss
+
+        step = jax.jit(
+            jax.shard_map(
+                train_step,
+                mesh=mesh,
+                in_specs=(specs, opt_specs, P("dp"), P("dp")),
+                out_specs=(specs, opt_specs, P()),
+            ),
+            donate_argnums=(0, 1),
+        )
+        place = lambda tree, sp: jax.device_put(
+            tree,
+            jax.tree.map(
+                lambda s: NamedSharding(mesh, s), sp,
+                is_leaf=lambda x: isinstance(x, P),
+            ),
+        )
+        n_params = sum(x.size for x in jax.tree.leaves(params))
+        if fast:
+            # bf16 model params, fp32 masters live in the optimizer state
+            params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+        return place(params, specs), place(opt_state, opt_specs), step, n_params
+
+    def run(fast: bool):
+        params, opt_state, step, n_params = build_step(fast)
+        key = jax.random.PRNGKey(1)
+        tokens = jax.random.randint(
+            key, (BATCH, SEQ), 0, cfg_common["vocab_size"]
+        )
+        targets = jnp.roll(tokens, -1, axis=1)
+        for _ in range(WARMUP):
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+        # host readback, not block_until_ready: the axon tunnel backend's
+        # block_until_ready returns before device execution completes; the
+        # data dependency through `loss` forces the whole step chain
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+        final_loss = float(loss)
+        dt = time.perf_counter() - t0
+        assert jnp.isfinite(final_loss), "non-finite loss in benchmark"
+        tps = BATCH * SEQ * STEPS / dt
+        log(f"{'fast' if fast else 'base'}: {dt/STEPS*1e3:.1f} ms/step, "
+            f"{tps:,.0f} tokens/s, loss {final_loss:.3f}")
+        return tps, n_params
+
+    log(f"devices: {jax.devices()}")
+    base, _ = run(fast=False)
+    fast, n_params = run(fast=True)
+
+    # model FLOPs per token: 6*N (fwd+bwd matmuls) + 12*L*h*s attention
+    flops_per_token = (
+        6 * n_params
+        + 12 * cfg_common["num_layers"] * cfg_common["hidden_size"] * SEQ
+    )
+    peak = _peak_flops(jax.devices()[0]) if on_tpu else None
+    mfu = round(fast * flops_per_token / peak, 4) if peak else None
+    print(json.dumps({
+        "metric": "gpt_tp1_tokens_per_sec",
+        "value": round(fast, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(fast / base, 3),
+        "platform": platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+        "mfu": mfu,
+        "n_params": n_params,
+        "ms_per_step": round(BATCH * SEQ / fast * 1e3, 2),
+        **({} if on_tpu else {"note": (
+            "cpu fallback (TPU unreachable): bf16 has no CPU matrix "
+            "units, so vs_baseline is not representative of TPU"
+        )}),
+    }))
+
+
+def child_extras(platform: str):
+    """BASELINE.md extra targets: RN50-ish images/sec (bf16+SyncBN-off,
+    O2-analog) and FusedLAMB vs unfused per-tensor LAMB step time on a
+    BERT-large-shaped param set (scaled down on the CPU fallback)."""
+    if platform == "cpu":
+        _pin_cpu()
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = platform != "cpu"
+    out = {"platform": platform}
+
+    # ---- RN50 images/sec, amp-O2 analog (bf16 compute, fp32 masters)
+    from apex_tpu.models.resnet import ResNet, ResNetConfig
+    from apex_tpu.optimizers import FusedAdam
+
+    batch = 64 if on_tpu else 4
+    size = 224 if on_tpu else 32
+    model = ResNet(ResNetConfig(
+        depth=50 if on_tpu else 18,
+        compute_dtype=jnp.bfloat16,
+        sync_bn_axis=None,
+    ))
+    params, batch_stats = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-3, master_weights=True)
     opt_state = opt.init(params)
-    opt_specs = state_specs_like(specs, opt_state)
+    images = jax.random.normal(
+        jax.random.PRNGKey(1), (batch, size, size, 3), jnp.bfloat16
+    )
+    labels = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
 
-    def train_step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(model.loss)(params, tokens, targets)
-        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+    @jax.jit
+    def rn_step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, new_stats = model.apply(
+                p, batch_stats, images, training=True
+            )
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.mean(
+                jnp.take_along_axis(logp, labels[:, None], axis=1)
+            ), new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
         new_params, new_opt = opt.step(opt_state, grads, params)
-        return new_params, new_opt, loss
+        return new_params, new_stats, new_opt, loss
 
-    step = jax.jit(
-        jax.shard_map(
-            train_step,
-            mesh=mesh,
-            in_specs=(specs, opt_specs, P("dp"), P("dp")),
-            out_specs=(specs, opt_specs, P()),
-        ),
-        donate_argnums=(0, 1),
-    )
-    place = lambda tree, sp: jax.device_put(
-        tree,
-        jax.tree.map(
-            lambda s: NamedSharding(mesh, s), sp,
-            is_leaf=lambda x: isinstance(x, P),
-        ),
-    )
-    if fast:
-        # bf16 model params, fp32 masters live in the optimizer state
-        params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
-    return place(params, specs), place(opt_state, opt_specs), step
-
-
-def run(fast: bool) -> float:
-    params, opt_state, step = build_step(fast)
-    key = jax.random.PRNGKey(1)
-    tokens = jax.random.randint(key, (BATCH, SEQ), 0, 32768)
-    targets = jnp.roll(tokens, -1, axis=1)
-    for _ in range(WARMUP):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
-    # host readback, not block_until_ready: the axon tunnel backend's
-    # block_until_ready returns before device execution completes, and the
-    # data dependency through `loss` is what forces the whole step chain
+    p = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+    for _ in range(2):
+        p, batch_stats, opt_state, loss = rn_step(
+            p, batch_stats, opt_state, images, labels
+        )
     float(loss)
+    steps = 10 if on_tpu else 3
     t0 = time.perf_counter()
-    for _ in range(STEPS):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
-    final_loss = float(loss)
+    for _ in range(steps):
+        p, batch_stats, opt_state, loss = rn_step(
+            p, batch_stats, opt_state, images, labels
+        )
+    float(loss)
     dt = time.perf_counter() - t0
-    assert jnp.isfinite(final_loss), "non-finite loss in benchmark"
-    tps = BATCH * SEQ * STEPS / dt
-    log(f"{'fast' if fast else 'base'}: {dt/STEPS*1e3:.1f} ms/step, "
-        f"{tps:,.0f} tokens/s, loss {final_loss:.3f}")
-    return tps
+    out["rn50_images_per_sec"] = round(batch * steps / dt, 1)
+    out["rn50_batch"] = batch
+    out["rn50_depth"] = model.config.depth
+    out["rn50_image_size"] = size
+    log(f"rn50: {out['rn50_images_per_sec']} images/s (batch {batch})")
+
+    # ---- FusedLAMB (one jitted pytree step) vs unfused LAMB (same math,
+    # one dispatch per tensor per stage — the pre-multi-tensor torch
+    # optimizer pattern the reference's fused kernels beat),
+    # BERT-large-shaped tensor list (~1 embed + 4 mats x L layers)
+    from apex_tpu.optimizers import FusedLAMB
+
+    h, L, vocab = (1024, 24, 30522) if on_tpu else (256, 4, 1024)
+    key = jax.random.PRNGKey(3)
+    params = {"embed": jax.random.normal(key, (vocab, h)) * 0.02}
+    for i in range(L):
+        params[f"l{i}"] = {
+            "qkv": jax.random.normal(key, (h, 3 * h)) * 0.02,
+            "proj": jax.random.normal(key, (h, h)) * 0.02,
+            "fc1": jax.random.normal(key, (h, 4 * h)) * 0.02,
+            "fc2": jax.random.normal(key, (4 * h, h)) * 0.02,
+        }
+    grads = jax.tree.map(lambda p: p * 1e-3, params)
+
+    lamb = FusedLAMB(lr=1e-3, use_nvlamb=True)
+    lamb_state = lamb.init(params)
+    lamb_step = jax.jit(lambda s, g, p: lamb.step(s, g, p))
+
+    # unfused reference: identical LAMB math, leaf at a time
+    b1, b2, eps, wd, lr, max_norm = 0.9, 0.999, 1e-6, 0.01, 1e-3, 1.0
+
+    @jax.jit
+    def leaf_sqnorm(g):
+        return jnp.sum(jnp.square(g.astype(jnp.float32)))
+
+    @jax.jit
+    def leaf_lamb(p, g, m, v, clip, step):
+        g = g.astype(jnp.float32) * clip
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        upd = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+        pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+        un = jnp.sqrt(jnp.sum(jnp.square(upd)))
+        trust = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
+        return p - lr * trust * upd, m, v
+
+    def unfused_step(state, grads, params):
+        leaves_p, treedef = jax.tree.flatten(params)
+        leaves_g = jax.tree.leaves(grads)
+        # one dispatch per tensor for the norm, host-side combine — the
+        # unfused pattern (reference computes this fused in one kernel)
+        gnorm = float(
+            jnp.sqrt(sum(float(leaf_sqnorm(g)) for g in leaves_g))
+        )
+        clip = min(1.0, max_norm / max(gnorm, 1e-12))
+        step = state["step"] + 1
+        new_p, new_m, new_v = [], [], []
+        for p_, g_, m_, v_ in zip(
+            leaves_p, leaves_g, state["m"], state["v"]
+        ):
+            p2, m2, v2 = leaf_lamb(p_, g_, m_, v_, clip, step)
+            new_p.append(p2)
+            new_m.append(m2)
+            new_v.append(v2)
+        return (
+            {"step": step, "m": new_m, "v": new_v},
+            jax.tree.unflatten(treedef, new_p),
+        )
+
+    zeros = [jnp.zeros_like(x, jnp.float32) for x in jax.tree.leaves(params)]
+    unfused_state = {"step": 0, "m": list(zeros), "v": list(zeros)}
+
+    def timeit(fn, *args, n=20):
+        # full host readback, not block_until_ready: the axon tunnel
+        # backend's block_until_ready returns before device execution
+        # completes; device_get of the last call's outputs forces the
+        # in-order dispatch queue to drain
+        jax.device_get(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            outp = fn(*args)
+        jax.device_get(outp)
+        return (time.perf_counter() - t0) / n * 1e3
+
+    out["fused_lamb_ms"] = round(
+        timeit(lamb_step, lamb_state, grads, params), 3
+    )
+    out["unfused_lamb_ms"] = round(
+        timeit(unfused_step, unfused_state, grads, params), 3
+    )
+    out["lamb_speedup"] = round(
+        out["unfused_lamb_ms"] / out["fused_lamb_ms"], 2
+    )
+    log(f"lamb fused {out['fused_lamb_ms']} ms vs unfused "
+        f"{out['unfused_lamb_ms']} ms ({out['lamb_speedup']}x)")
+    print(json.dumps(out))
+
+
+# ---------------------------------------------------------------- orchestrator
+def _run_child(args, timeout):
+    """Run `python bench.py <args>` bounded; return (ok, last_json, tail)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False, None, f"timeout after {timeout}s"
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        return False, None, (proc.stderr or "")[-1500:]
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return True, json.loads(line), ""
+        except json.JSONDecodeError:
+            continue
+    return False, None, "no JSON in child output"
 
 
 def main():
-    log(f"devices: {jax.devices()}")
-    base = run(fast=False)
-    fast = run(fast=True)
-    print(
-        json.dumps(
-            {
-                "metric": "gpt_tp1_tokens_per_sec",
-                "value": round(fast, 1),
-                "unit": "tokens/s",
-                "vs_baseline": round(fast / base, 3),
-            }
+    t_start = time.perf_counter()
+    errors = []
+
+    platform = None
+    for attempt in range(RETRIES):
+        ok, probe, err = _run_child(["--child", "probe"], PROBE_TIMEOUT)
+        if ok:
+            platform = probe["platform"]
+            log(f"probe: {probe}")
+            break
+        errors.append(f"probe[{attempt}]: {err.strip().splitlines()[-1] if err.strip() else err}")
+        log(f"probe attempt {attempt} failed: {err[-300:]}")
+        if attempt < RETRIES - 1:
+            time.sleep(BACKOFF[min(attempt, len(BACKOFF) - 1)])
+
+    result = None
+    if platform is not None and platform != "cpu":
+        for attempt in range(2):
+            ok, result, err = _run_child(
+                ["--child", "gpt", "--platform", platform], CHILD_TIMEOUT
+            )
+            if ok:
+                break
+            errors.append(f"tpu-gpt[{attempt}]: {err[-300:]}")
+            result = None
+            if attempt == 0:
+                time.sleep(30)
+
+    if result is None:
+        # TPU unreachable or measurement failed: CPU fallback so the
+        # bench still emits a valid, clearly-marked measurement
+        ok, result, err = _run_child(
+            ["--child", "gpt", "--platform", "cpu"], CHILD_TIMEOUT
         )
-    )
+        if not ok:
+            errors.append(f"cpu-gpt: {err[-300:]}")
+            print(json.dumps({
+                "metric": "gpt_tp1_tokens_per_sec",
+                "value": 0.0,
+                "unit": "tokens/s",
+                "vs_baseline": 0.0,
+                "error": "; ".join(errors)[-800:],
+            }))
+            return
+
+    # extra BASELINE.md targets — never allowed to break the main metric
+    budget_left = TOTAL_BUDGET - (time.perf_counter() - t_start)
+    if budget_left <= 300:
+        log(f"skipping extras: only {budget_left:.0f}s of total budget left")
+    if budget_left > 300:
+        ok, extras, err = _run_child(
+            ["--child", "extras", "--platform", result.get("platform", "cpu")],
+            min(budget_left, CHILD_TIMEOUT),
+        )
+        if ok:
+            try:
+                with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_EXTRA.json",
+                ), "w") as f:
+                    json.dump(extras, f, indent=1)
+            except OSError as e:
+                log(f"extras write failed: {e}")
+            log(f"extras: {extras}")
+        else:
+            log(f"extras failed (non-fatal): {err[-300:]}")
+
+    if errors:
+        prior = result.get("note", "")
+        result["note"] = (prior + "; " if prior else "") + "; ".join(errors)[-500:]
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        kind = sys.argv[sys.argv.index("--child") + 1]
+        plat = (
+            sys.argv[sys.argv.index("--platform") + 1]
+            if "--platform" in sys.argv else "cpu"
+        )
+        if kind == "probe":
+            child_probe()
+        elif kind == "gpt":
+            child_gpt(plat)
+        elif kind == "extras":
+            child_extras(plat)
+        else:
+            raise SystemExit(f"unknown child {kind}")
+    else:
+        main()
