@@ -277,11 +277,15 @@ class DistributedFusedLAMB(_DistributedOptimizer):
         else:
             clip = jnp.float32(1.0)
         g = g * clip
+        if not self.adam_w_mode and self.weight_decay != 0.0:
+            # MOMENT_MODE_0 (classic/L2): decay folds into the gradient
+            # *before* the moment updates (multi_tensor_lamb.cu).
+            g = g + wd * p
 
         m = b1 * extra["exp_avg"] + beta3 * g
         v = b2 * extra["exp_avg_sq"] + (1.0 - b2) * jnp.square(g)
         update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
-        if self.weight_decay != 0.0:
+        if self.adam_w_mode and self.weight_decay != 0.0:
             update = update + wd * p
 
         w_norms = self._segment_norms(p, ids_local, meta)

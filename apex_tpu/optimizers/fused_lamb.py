@@ -83,18 +83,18 @@ class FusedLAMB(FusedOptimizer):
 
         def upd(p, g, m, v):
             g = g * clip
+            if not self.adam_w_mode and self.weight_decay != 0.0:
+                # MOMENT_MODE_0 (classic/L2): decay folds into the gradient
+                # *before* the moment updates (multi_tensor_lamb.cu).
+                g = g + wd * p
             m = b1 * m + beta3 * g
             v = b2 * v + (1.0 - b2) * jnp.square(g)
             m_hat = m / bc1
             v_hat = v / bc2
             update = m_hat / (jnp.sqrt(v_hat) + self.eps)
-            if self.weight_decay != 0.0:
-                if self.adam_w_mode:
-                    update = update + wd * p
-                else:
-                    # classic-Adam style decay folds into the gradient; the
-                    # reference kernel handles both via the `mode` flag.
-                    update = update + wd * p
+            if self.adam_w_mode and self.weight_decay != 0.0:
+                # MOMENT_MODE_1 (AdamW): decoupled decay on the update.
+                update = update + wd * p
             w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
             u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
             apply_trust = (w_norm > 0) & (u_norm > 0)

@@ -2,11 +2,19 @@
 probing (reference: apex/parallel/__init__.py:13-19, apex/amp/scaler.py:66-80):
 every fused op here has a Pallas fast path and a pure-XLA fallback, chosen
 at trace time.
+
+Detection is stateless and keyed on the *current* default JAX backend.  A
+mid-process backend switch is picked up as soon as JAX itself re-resolves
+the backend — i.e. after ``jax.extend.backend.clear_backends()`` +
+``jax.config.update("jax_platforms", ...)``, which is exactly what
+``__graft_entry__._force_cpu_platform`` performs (a bare config update
+without clearing leaves JAX's own backend cache, and therefore this module,
+on the old platform).  The env override ``APEX_TPU_DISABLE_PALLAS`` is
+honored per call.
 """
 
 from __future__ import annotations
 
-import functools
 import os
 
 __all__ = ["is_tpu", "supports_pallas", "default_implementation"]
@@ -14,17 +22,20 @@ __all__ = ["is_tpu", "supports_pallas", "default_implementation"]
 _TPU_PLATFORMS = ("tpu", "axon")
 
 
-@functools.lru_cache(maxsize=1)
-def is_tpu() -> bool:
+def _current_platform() -> str:
     try:
         import jax
 
-        return jax.devices()[0].platform.lower() in _TPU_PLATFORMS
+        # cached inside JAX; re-resolves once clear_backends() has run
+        return jax.default_backend().lower()
     except Exception:
-        return False
+        return "unknown"
 
 
-@functools.lru_cache(maxsize=1)
+def is_tpu() -> bool:
+    return _current_platform() in _TPU_PLATFORMS
+
+
 def supports_pallas() -> bool:
     """Whether Pallas TPU kernels can compile on the current backend."""
     if os.environ.get("APEX_TPU_DISABLE_PALLAS"):

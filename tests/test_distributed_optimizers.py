@@ -137,12 +137,13 @@ class TestDistributedFusedAdam:
 
 
 class TestDistributedFusedLAMB:
+    @pytest.mark.parametrize("adam_w_mode", [True, False])
     @pytest.mark.parametrize("use_nvlamb", [False, True])
-    def test_matches_unsharded(self, mesh, use_nvlamb):
+    def test_matches_unsharded(self, mesh, use_nvlamb, adam_w_mode):
         params, grads = make_params_grads(jax.random.PRNGKey(1))
         kw = dict(
             lr=1e-2, weight_decay=0.01, max_grad_norm=0.05,
-            use_nvlamb=use_nvlamb,
+            use_nvlamb=use_nvlamb, adam_w_mode=adam_w_mode,
         )
         dopt = DistributedFusedLAMB(**kw)
         sharded_params, _ = run_sharded(mesh, dopt, params, grads)

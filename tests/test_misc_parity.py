@@ -63,3 +63,24 @@ def test_multiproc_launcher_wires_env(tmp_path):
     assert out.returncode == 0, out.stderr
     lines = sorted(out.stdout.strip().splitlines())
     assert lines == ["0 2", "1 2"]
+
+
+def test_platform_detection_tracks_backend(monkeypatch):
+    """A mid-process backend switch must not leave is_tpu() stale
+    (the situation __graft_entry__._force_cpu_platform creates)."""
+    from apex_tpu.utils import platform as plat
+
+    monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
+    monkeypatch.setattr(plat, "_current_platform", lambda: "tpu")
+    assert plat.is_tpu()
+    assert plat.default_implementation() == "pallas"
+    # flip the backend mid-process: detection must follow, no reset needed
+    monkeypatch.setattr(plat, "_current_platform", lambda: "cpu")
+    assert not plat.is_tpu()
+    assert plat.default_implementation() == "xla"
+    # env override is honored per call, not cached
+    monkeypatch.setattr(plat, "_current_platform", lambda: "tpu")
+    monkeypatch.setenv("APEX_TPU_DISABLE_PALLAS", "1")
+    assert plat.is_tpu() and not plat.supports_pallas()
+    monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS")
+    assert plat.supports_pallas()

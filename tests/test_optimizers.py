@@ -202,6 +202,66 @@ class TestFusedLAMB:
         new_params, _ = opt.step(state, huge, params)
         assert np.all(np.isfinite(np.asarray(new_params["w"])))
 
+    @pytest.mark.parametrize("use_nvlamb", [False, True])
+    @pytest.mark.parametrize("adam_w_mode,wd", [
+        (True, 0.1),
+        (False, 0.1),
+        (True, 0.0),   # wd=0: trust ratio applies only under nvlamb
+    ])
+    def test_decay_modes_vs_numpy(self, adam_w_mode, wd, use_nvlamb):
+        # NumPy transliteration of the reference kernel's two decay modes
+        # (multi_tensor_lamb.cu): MOMENT_MODE_0 folds wd*p into the gradient
+        # *before* the moment updates; MOMENT_MODE_1 (AdamW) adds wd*p to the
+        # final update. The two diverge after the first step because the
+        # moments see different gradients.
+        lr, b1, b2, eps, clip_norm = 0.02, 0.9, 0.999, 1e-6, 1.0
+        np_params, grads_seq = _random_problem(seed=7, steps=4)
+
+        ref = [p.copy() for p in np_params]
+        ms = [np.zeros_like(p) for p in np_params]
+        vs = [np.zeros_like(p) for p in np_params]
+        for step, grads in enumerate(grads_seq, start=1):
+            gnorm = np.sqrt(sum(np.sum(g.astype(np.float64) ** 2) for g in grads))
+            scale = clip_norm / gnorm if gnorm > clip_norm else 1.0
+            bc1 = 1.0 - b1**step
+            bc2 = 1.0 - b2**step
+            for i, g in enumerate(grads):
+                g = g * scale
+                if not adam_w_mode and wd != 0.0:
+                    g = g + wd * ref[i]
+                ms[i] = b1 * ms[i] + (1.0 - b1) * g
+                vs[i] = b2 * vs[i] + (1.0 - b2) * g * g
+                update = (ms[i] / bc1) / (np.sqrt(vs[i] / bc2) + eps)
+                if adam_w_mode and wd != 0.0:
+                    update = update + wd * ref[i]
+                if wd == 0.0 and not use_nvlamb:
+                    trust = 1.0
+                else:
+                    w_norm = np.linalg.norm(ref[i])
+                    u_norm = np.linalg.norm(update)
+                    trust = w_norm / u_norm if (w_norm > 0 and u_norm > 0) else 1.0
+                ref[i] = ref[i] - lr * trust * update
+
+        ours = _run_jax(
+            FusedLAMB(
+                lr=lr, weight_decay=wd, adam_w_mode=adam_w_mode,
+                use_nvlamb=use_nvlamb, max_grad_norm=clip_norm, eps=eps,
+            ),
+            np_params,
+            grads_seq,
+        )
+        for a, b in zip(ours, ref):
+            np.testing.assert_allclose(a, b.astype(np.float32), rtol=2e-4, atol=1e-5)
+
+    def test_decay_modes_diverge(self):
+        # guards against the two branches silently collapsing into one
+        np_params, grads_seq = _random_problem(seed=8, steps=3)
+        a = _run_jax(FusedLAMB(lr=0.02, weight_decay=0.1, adam_w_mode=True),
+                     np_params, grads_seq)
+        b = _run_jax(FusedLAMB(lr=0.02, weight_decay=0.1, adam_w_mode=False),
+                     np_params, grads_seq)
+        assert any(np.max(np.abs(x - y)) > 1e-5 for x, y in zip(a, b))
+
 
 class TestFusedNovoGrad:
     def test_decreases_loss(self):
