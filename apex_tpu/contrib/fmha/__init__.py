@@ -9,7 +9,10 @@ the reference's packed-varlen calling convention: qkv packed as
 
 Varlen is realized the XLA-friendly way: segment-id masking inside one
 padded batch (dynamic shapes would defeat jit), which is how TPU
-production stacks express varlen attention.
+production stacks express varlen attention.  The segment masking happens
+*inside* the flash kernel (``ops/attention.py``), so unlike the
+reference's seqlen<=512 window this path has no length limit and never
+materialises the (s, s) score matrix.
 """
 
 from __future__ import annotations
@@ -19,7 +22,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.attention import flash_attention, mha_reference
+from apex_tpu.ops.attention import flash_attention
 
 __all__ = ["fmha", "FMHA"]
 
@@ -29,6 +32,7 @@ def fmha(
     cu_seqlens: jnp.ndarray,
     max_seq_len: int,
     causal: bool = False,
+    implementation: Optional[str] = None,
 ) -> jnp.ndarray:
     """Packed-varlen attention (reference: ``FMHAFun.apply``).
 
@@ -53,11 +57,15 @@ def fmha(
     )  # (b, heads, s, d)
     lengths = cu_seqlens[1:] - cu_seqlens[:-1]  # (b,)
     key_pos = jnp.arange(max_seq_len)
-    # additive mask: padded keys contribute -inf
-    bias = jnp.where(
-        key_pos[None, :] < lengths[:, None], 0.0, -1e30
-    )[:, None, None, :]  # (b, 1, 1, s)
-    out = mha_reference(q, k, v, causal=causal, bias=bias)
+    valid = key_pos[None, :] < lengths[:, None]  # (b, s)
+    # real tokens are segment 0; query/key padding get distinct sentinels
+    # so padded positions never attend or get attended
+    q_seg = jnp.where(valid, 0, -1).astype(jnp.int32)
+    kv_seg = jnp.where(valid, 0, -2).astype(jnp.int32)
+    out = flash_attention(
+        q, k, v, causal=causal, q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+        implementation=implementation,
+    )
     out = jnp.moveaxis(out, 1, 2).reshape(b * max_seq_len, heads, d)
     return out[batch_idx]
 

@@ -33,13 +33,34 @@ def _xavier(key, shape, dtype, gain=1.0):
     return jax.random.uniform(key, shape, dtype, -a, a)
 
 
-def _attend(q, k, v, scale, mask_bias, causal, impl):
-    """q,k,v: (b, h, s, d).  mask_bias: additive (b,1,1,sk) or None."""
-    if impl == "fast" and mask_bias is None:
-        return flash_attention(q, k, v, causal=causal, sm_scale=scale)
-    return mha_reference(
-        q, k, v, causal=causal, sm_scale=scale, bias=mask_bias
+def _attend(q, k, v, scale, mask_bias, causal, impl,
+            kv_pad_mask=None, dropout_rate=0.0, rng=None):
+    """q,k,v: (b, h, s, d).  mask_bias: additive (b,1,sq,sk) or None;
+    kv_pad_mask: (b, sk) True = masked-out key (torch convention).
+
+    Probability dropout happens *inside* the attention (the reference
+    fuses it into its CUDA kernels via Philox; here the flash kernel's
+    counter-based hash plays that role, and the 'default' XLA path draws
+    the identical mask)."""
+    q_seg = kv_seg = None
+    if kv_pad_mask is not None:
+        # segment ids keep padding exclusion inside the flash kernel
+        kv_seg = jnp.where(kv_pad_mask, -2, 0).astype(jnp.int32)
+        q_seg = jnp.zeros((q.shape[0], q.shape[2]), jnp.int32)
+    seed = None
+    if dropout_rate > 0.0 and rng is not None:
+        seed = jax.random.bits(rng, dtype=jnp.uint32)
+    else:
+        dropout_rate = 0.0
+    kwargs = dict(
+        causal=causal, sm_scale=scale, bias=mask_bias,
+        q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+        dropout_rate=dropout_rate, dropout_seed=seed,
     )
+    if impl == "fast":
+        # attn_mask is a constant mask, never a parameter: skip dbias
+        return flash_attention(q, k, v, bias_requires_grad=False, **kwargs)
+    return mha_reference(q, k, v, **kwargs)
 
 
 class _MHABase:
@@ -143,20 +164,18 @@ class SelfMultiheadAttn(_MHABase):
         )
 
         bias = None
-        if key_padding_mask is not None:
-            # True = masked-out key (torch convention): (b, sk) → additive
-            bias = jnp.where(key_padding_mask, -1e30, 0.0)[:, None, None, :]
         if attn_mask is not None:
             add = jnp.where(attn_mask, -1e30, 0.0) if attn_mask.dtype == jnp.bool_ \
                 else attn_mask
             add = jnp.broadcast_to(add, (b, 1, s, s)) if add.ndim == 2 \
                 else add
-            bias = add if bias is None else bias + add
+            bias = add
 
-        ctx = _attend(q, k, v, self.scale, bias, causal, self.impl)
-        if self.dropout > 0.0 and is_training and rng is not None:
-            keep = jax.random.bernoulli(rng, 1.0 - self.dropout, ctx.shape)
-            ctx = jnp.where(keep, ctx / (1.0 - self.dropout), 0.0)
+        ctx = _attend(
+            q, k, v, self.scale, bias, causal, self.impl,
+            kv_pad_mask=key_padding_mask,
+            dropout_rate=self.dropout if is_training else 0.0, rng=rng,
+        )
         out = jnp.matmul(
             self._bhsd_to_sbh(ctx), params["out_weight"].astype(ctx.dtype)
         )
@@ -221,14 +240,11 @@ class EncdecMultiheadAttn(_MHABase):
         )
         q = self._sbh_to_bhsd(q)
 
-        bias = None
-        if key_padding_mask is not None:
-            bias = jnp.where(key_padding_mask, -1e30, 0.0)[:, None, None, :]
-
-        ctx = _attend(q, k_, v_, self.scale, bias, False, self.impl)
-        if self.dropout > 0.0 and is_training and rng is not None:
-            keep = jax.random.bernoulli(rng, 1.0 - self.dropout, ctx.shape)
-            ctx = jnp.where(keep, ctx / (1.0 - self.dropout), 0.0)
+        ctx = _attend(
+            q, k_, v_, self.scale, None, False, self.impl,
+            kv_pad_mask=key_padding_mask,
+            dropout_rate=self.dropout if is_training else 0.0, rng=rng,
+        )
         out = jnp.matmul(
             self._bhsd_to_sbh(ctx), params["out_weight"].astype(ctx.dtype)
         )

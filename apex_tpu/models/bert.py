@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from apex_tpu.ops.attention import mha_reference
+from apex_tpu.ops.attention import flash_attention
 from apex_tpu.ops.layer_norm import fused_layer_norm_affine
 from apex_tpu.transformer.parallel_state import (
     DATA_PARALLEL_AXIS,
@@ -48,6 +48,7 @@ class BertConfig:
     compute_dtype: Any = jnp.bfloat16
     remat: bool = True
     add_binary_head: bool = True
+    attention_impl: Optional[str] = None  # "pallas" | "xla" | None=auto
 
     def __post_init__(self):
         if self.ffn_hidden_size is None:
@@ -200,7 +201,7 @@ class BertModel:
         return specs
 
     # ------------------------------------------------------------- forward
-    def _layer(self, lp, x, bias):
+    def _layer(self, lp, x, segs):
         c = self.config
         world = jax.lax.axis_size(self.axis_name)
         heads_local = c.num_attention_heads // world
@@ -216,7 +217,13 @@ class BertModel:
         q, k, v = (
             jnp.moveaxis(qkv[:, :, :, i], 2, 1) for i in range(3)
         )
-        attn = mha_reference(q, k, v, causal=False, bias=bias)
+        # padding exclusion via segment ids keeps the flash kernel on its
+        # fast path (a dense additive bias would force dbias accumulation)
+        q_seg, kv_seg = segs if segs is not None else (None, None)
+        attn = flash_attention(
+            q, k, v, causal=False, q_segment_ids=q_seg,
+            kv_segment_ids=kv_seg, implementation=c.attention_impl,
+        )
         attn = jnp.moveaxis(attn, 1, 2).reshape(b, s, heads_local * c.head_dim)
         out = self.attn_proj.apply(lp["attn_proj"], attn)
         x = residual + out.astype(residual.dtype)
@@ -250,12 +257,17 @@ class BertModel:
             ).astype(x.dtype)
         x = x.astype(c.compute_dtype)
 
-        bias = None
+        segs = None
         if attention_mask is not None:
-            bias = jnp.where(attention_mask, 0.0, -1e30)[:, None, None, :]
+            # keep-tokens form segment 0; masked keys get a sentinel that
+            # never matches a query segment, so they are excluded exactly
+            # like the reference's additive -inf mask
+            kv_seg = jnp.where(attention_mask, 0, -2).astype(jnp.int32)
+            q_seg = jnp.zeros_like(kv_seg)
+            segs = (q_seg, kv_seg)
 
         def body(carry, lp):
-            return self._layer(lp, carry, bias), None
+            return self._layer(lp, carry, segs), None
 
         scan_body = body
         if c.remat:

@@ -269,26 +269,19 @@ class GPTModel:
         )  # each (b, heads_local, s, d)
         if c.attention_dropout > 0.0 and key is not None:
             # Megatron semantics: dropout on the softmax *probabilities*
-            # (reference: standalone_gpt.py attention_probs dropout); the
-            # flash kernel has no prob-dropout hook, so training with
-            # attention_dropout takes the explicit-softmax path.  Keys are
-            # tagged before folding in mesh axes so the attention / hidden
+            # (reference: standalone_gpt.py attention_probs dropout), kept
+            # INSIDE the flash kernel via its counter-based hash (the role
+            # philox.h plays in the reference's fused MHA).  The seed is
+            # drawn after folding in mesh axes, so the attention / hidden
             # dropout streams can never collide across ranks.
             akey = model_parallel_key(
                 data_parallel_key(jax.random.fold_in(key, 0)), self.axis_name
             )
-            scores = jnp.einsum(
-                "bhqd,bhkd->bhqk", q, k
-            ).astype(jnp.float32) / (c.head_dim**0.5)
-            causal_mask = jnp.tril(jnp.ones((s, s), bool))
-            scores = jnp.where(causal_mask, scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1)
-            keep = jax.random.bernoulli(
-                akey, 1.0 - c.attention_dropout, probs.shape
-            )
-            probs = jnp.where(keep, probs / (1.0 - c.attention_dropout), 0.0)
-            attn = jnp.einsum(
-                "bhqk,bhkd->bhqd", probs.astype(v.dtype), v
+            seed = jax.random.bits(akey, dtype=jnp.uint32)
+            attn = flash_attention(
+                q, k, v, causal=True,
+                dropout_rate=c.attention_dropout, dropout_seed=seed,
+                implementation=c.attention_impl,
             )
         elif c.context_parallel:
             from apex_tpu.ops.ring_attention import ring_attention

@@ -6,28 +6,45 @@ eight ``fast_*_multihead_attn`` extensions
 (apex/contrib/csrc/multihead_attn/).  Those kernels materialise the
 (sq, sk) score matrix per head; flash attention never does, so the TPU
 design has no seqlen window: one online-softmax kernel covers every
-sequence length, causal or not, bf16-first.
+sequence length, causal or not, bf16-first.  Beyond the reference's
+kernels this one also supports, *in kernel*:
+
+- **segment ids** (varlen): the TPU-native form of the reference's
+  ``cu_seqlens`` packed-batch API (apex/contrib/fmha/fmha.py:33-80) —
+  tokens attend only within equal segment ids;
+- **additive bias** with a real bias gradient;
+- **probability dropout** replayed exactly in the backward pass from a
+  counter-based hash (the role Philox plays in the reference,
+  apex/contrib/csrc/multihead_attn/philox.h) — the same hash evaluates
+  in plain XLA, so the reference path produces bit-identical masks and
+  the two implementations stay directly comparable.
 
 Layout: ``(batch, heads, seq, head_dim)``.  Softmax statistics are fp32;
 the accumulator is fp32; output matches the input dtype.
 
-Kernel strategy (chosen for VMEM residency, see pallas_guide):
-- forward: grid ``(batch*heads, q_blocks)``; K/V for the whole sequence
-  sit in VMEM per program (S=8k in bf16 is ~2 MB each at d=128) and the
-  kernel walks K in ``block_k`` slices with a ``fori_loop`` whose trip
-  count shrinks under causal masking.
-- backward: two kernels — dK/dV over ``(batch*heads, k_blocks)`` and dQ
-  over ``(batch*heads, q_blocks)`` — both replaying scores from the saved
-  log-sum-exp, the standard flash-attention-2 recomputation split.
+Kernel strategy (chosen for VMEM residency, see pallas_guide): all three
+kernels run a 3-D grid with the reduction dimension innermost and carry
+running state in VMEM scratch, so **no kernel ever holds a whole
+sequence of K/V** — per-program residency is O(block_q·d + block_k·d)
+and long sequences (32k+) compile:
+
+- forward: grid ``(batch*heads, q_blocks, k_blocks)``; online-softmax
+  (m, l, acc) scratch accumulates across the k-block dimension.
+- backward dK/dV: grid ``(batch*heads, k_blocks, q_blocks)``; dK/dV
+  scratch accumulates across the q-block dimension.
+- backward dQ (+dBias): grid ``(batch*heads, q_blocks, k_blocks)``;
+  dQ scratch accumulates across the k-block dimension.  Scores are
+  replayed from the saved log-sum-exp (flash-attention-2 split).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.ops.common import shape_struct
 from apex_tpu.utils.platform import default_implementation, is_tpu
@@ -42,6 +59,49 @@ except Exception:  # pragma: no cover
 __all__ = ["flash_attention", "mha_reference"]
 
 _NEG_INF = -1e30
+_LANES = 128
+
+
+# ---------------------------------------------------------------------------
+# Counter-based dropout hash (shared by the Pallas kernels and the XLA
+# reference so both paths draw the *same* mask for a given seed)
+# ---------------------------------------------------------------------------
+
+
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """32-bit finalizer (lowrey/murmur-style avalanche), uint32 in/out."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _keep_mask(seed, bh, q_idx, k_idx, keep_threshold):
+    """Deterministic keep mask for dropout.
+
+    ``seed``: uint32 scalar; ``bh``: flattened batch*head index (scalar or
+    array); ``q_idx``/``k_idx``: broadcastable int32 position arrays;
+    ``keep_threshold``: uint32 in [0, 2^24] = keep_prob * 2^24.
+    """
+    seed = seed.astype(jnp.uint32)
+    bh = jnp.asarray(bh).astype(jnp.uint32)
+    h = _mix32(seed ^ (bh * jnp.uint32(0x9E3779B1)))
+    r = _mix32(
+        (h + q_idx.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
+        ^ (k_idx.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D))
+    )
+    return (r >> 8) < keep_threshold
+
+
+def _keep_threshold(dropout_rate: float) -> int:
+    return int(round((1.0 - dropout_rate) * (1 << 24)))
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path
+# ---------------------------------------------------------------------------
 
 
 def mha_reference(
@@ -51,29 +111,54 @@ def mha_reference(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     bias: Optional[jnp.ndarray] = None,
+    q_segment_ids: Optional[jnp.ndarray] = None,
+    kv_segment_ids: Optional[jnp.ndarray] = None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
 ) -> jnp.ndarray:
     """Plain XLA attention with fp32 softmax — the correctness reference,
     playing the role of the reference's pure-PyTorch ``impl='default'``
-    path (apex/contrib/multihead_attn/self_multihead_attn_func.py)."""
-    d = q.shape[-1]
+    path (apex/contrib/multihead_attn/self_multihead_attn_func.py).
+
+    Dropout uses the same counter-based hash as the Pallas kernel, so for
+    a given ``dropout_seed`` both implementations drop the same entries.
+    """
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("segment ids must be given for both q and kv")
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
     scale = (1.0 / d**0.5) if sm_scale is None else sm_scale
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
+    mask = jnp.ones((1, 1, sq, sk), bool)
     if causal:
-        sq, sk = s.shape[-2:]
         q_idx = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         k_idx = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(k_idx > q_idx, _NEG_INF, s)
+        mask = mask & (k_idx <= q_idx)[None, None]
+    if q_segment_ids is not None:
+        mask = mask & (
+            q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
+        )
+    s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.broadcast_to(mask, p.shape), p, 0.0)
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        seed = jnp.asarray(dropout_seed, jnp.uint32)
+        bh_idx = jnp.arange(b * h, dtype=jnp.int32).reshape(b, h, 1, 1)
+        q_idx = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)[None, None]
+        k_idx = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)[None, None]
+        keep = _keep_mask(seed, bh_idx, q_idx, k_idx,
+                          jnp.uint32(_keep_threshold(dropout_rate)))
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     return jnp.einsum(
         "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
     ).astype(q.dtype)
-
-
 
 
 def _interpret() -> bool:
@@ -82,103 +167,212 @@ def _interpret() -> bool:
     return not is_tpu()
 
 
+class _FAConfig(NamedTuple):
+    """Static kernel configuration (hashable for custom_vjp)."""
+
+    sm_scale: float
+    causal: bool
+    dropout_rate: float
+    block_q: int
+    block_k: int
+    q_len: int       # unpadded
+    kv_len: int      # unpadded
+    heads: int       # heads per batch entry (for segment-id index maps)
+    # flattened-bias batching: 0 = no bias, 1 = one (sq, sk) bias shared by
+    # all programs, BIAS_PER_BATCH = one per batch entry (b, sq, sk),
+    # BIAS_PER_HEAD = one per program (b*h, sq, sk)
+    bias_batch: int
+    # whether the backward pass materialises dbias (False for constant
+    # masks keeps the causal block-skip and avoids a (b*h, sq, sk) buffer)
+    bias_grad: bool
+
+
+BIAS_PER_BATCH = -2
+BIAS_PER_HEAD = -1
+
+
 # ---------------------------------------------------------------------------
 # Pallas forward
 # ---------------------------------------------------------------------------
 
 
 def _fa_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref,
-    *, sm_scale, causal, block_q, block_k, kv_len,
+    *refs, cfg: _FAConfig, num_k: int, has_bias, has_segs, has_dropout,
 ):
-    j = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale          # (block_q, d)
-    d = q.shape[-1]
-    num_k = pl.cdiv(kv_len, block_k)
-    if causal:
-        # blocks wholly above the diagonal contribute nothing
-        num_k = jnp.minimum(
-            num_k, pl.cdiv((j + 1) * block_q, block_k)
-        )
+    (q_ref, k_ref, v_ref), rest = refs[:3], refs[3:]
+    bias_ref = qseg_ref = kseg_ref = seed_ref = None
+    if has_bias:
+        bias_ref, rest = rest[0], rest[1:]
+    if has_segs:
+        (qseg_ref, kseg_ref), rest = rest[:2], rest[2:]
+    if has_dropout:
+        seed_ref, rest = rest[0], rest[1:]
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
 
-    def body(kb, carry):
-        acc, m_prev, l_prev = carry
-        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    i, j, kb = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    block_q, block_k = cfg.block_q, cfg.block_k
+    if cfg.causal:
+        last_kb = jnp.minimum(
+            num_k - 1, ((j + 1) * block_q - 1) // block_k
+        )
+    else:
+        last_kb = num_k - 1
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(kb <= last_kb)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * cfg.sm_scale    # (block_q, d)
+        kblk = k_ref[0].astype(jnp.float32)                # (block_k, d)
+        vblk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )                                                 # (block_q, block_k)
+        )                                                  # (block_q, block_k)
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
+        q_global = j * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0
+        )
         k_global = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1
         )
-        mask = k_global < kv_len
-        if causal:
-            q_global = j * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0
-            )
+        mask = k_global < cfg.kv_len
+        if cfg.causal:
             mask = jnp.logical_and(mask, k_global <= q_global)
+        if has_segs:
+            mask = jnp.logical_and(
+                mask, qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
+            )
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
         s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
+        if has_dropout:
+            keep = _keep_mask(
+                seed_ref[0, 0], i, q_global, k_global,
+                jnp.uint32(_keep_threshold(cfg.dropout_rate)),
+            )
+            p_acc = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - cfg.dropout_rate))
+        else:
+            p_acc = p
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p_acc, vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_k, body, (acc0, m0, l0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    @pl.when(kb == last_kb)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l[:, 0])
 
 
-def _fa_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k):
-    bh, sq, d = q.shape
-    kv_len = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, kv_len)
-    pad_q = (-sq) % block_q
-    pad_k = (-kv_len) % block_k
-    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0))) if pad_q else q
-    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0))) if pad_k else k
-    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0))) if pad_k else v
-    psq, psk = sq + pad_q, kv_len + pad_k
-    grid = (bh, psq // block_q)
+def _fwd_in_specs(cfg, d, psq, psk, has_bias, has_segs, has_dropout,
+                  swap_grid=False):
+    """Input BlockSpecs shared by forward and dq kernels.
+
+    ``swap_grid``: dkv kernel uses grid (i, kb, jq); forward/dq use
+    (i, jq, kb).  Index maps below are written for (i, jq, kb) and
+    wrapped when swapped.
+    """
+    block_q, block_k, heads = cfg.block_q, cfg.block_k, cfg.heads
+
+    def w(f):  # rewire grid axes for the dkv kernel
+        if not swap_grid:
+            return f
+        return lambda i, kb, jq: f(i, jq, kb)
+
+    specs = [
+        pl.BlockSpec((1, block_q, d), w(lambda i, j, kb: (i, j, 0)),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), w(lambda i, j, kb: (i, kb, 0)),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), w(lambda i, j, kb: (i, kb, 0)),
+                     memory_space=pltpu.VMEM),
+    ]
+    if has_bias:
+        if cfg.bias_batch == 1:
+            bmap = lambda i, j, kb: (0, j, kb)
+        elif cfg.bias_batch == BIAS_PER_BATCH:
+            bmap = lambda i, j, kb: (i // heads, j, kb)
+        else:  # BIAS_PER_HEAD
+            bmap = lambda i, j, kb: (i, j, kb)
+        specs.append(
+            pl.BlockSpec((1, block_q, block_k), w(bmap),
+                         memory_space=pltpu.VMEM)
+        )
+    if has_segs:
+        # (b, 1, s) layout: the middle singleton keeps the trailing
+        # two block dims Mosaic-tileable ((1, block) vs the (8, 128) rule)
+        specs.append(pl.BlockSpec(
+            (1, 1, block_q), w(lambda i, j, kb: (i // heads, 0, j))
+        ))
+        specs.append(pl.BlockSpec(
+            (1, 1, block_k), w(lambda i, j, kb: (i // heads, 0, kb))
+        ))
+    if has_dropout:
+        specs.append(pl.BlockSpec(
+            (1, 1), w(lambda i, j, kb: (0, 0)), memory_space=pltpu.SMEM
+        ))
+    return specs
+
+
+def _compiler_params():
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
+
+
+def _fa_fwd_pallas(q, k, v, bias, qseg, kseg, seed, cfg: _FAConfig):
+    bh, psq, d = q.shape
+    psk = k.shape[1]
+    num_q, num_k = psq // cfg.block_q, psk // cfg.block_k
+    has_bias = bias is not None
+    has_segs = qseg is not None
+    has_dropout = cfg.dropout_rate > 0.0
+    inputs = [q, k, v]
+    if has_bias:
+        inputs.append(bias)
+    if has_segs:
+        inputs.extend([qseg, kseg])
+    if has_dropout:
+        inputs.append(seed)
     out, lse = pl.pallas_call(
         functools.partial(
-            _fa_fwd_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, kv_len=kv_len,
+            _fa_fwd_kernel, cfg=cfg, num_k=num_k, has_bias=has_bias,
+            has_segs=has_segs, has_dropout=has_dropout,
         ),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, psk, d), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, psk, d), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        grid=(bh, num_q, num_k),
+        in_specs=_fwd_in_specs(cfg, d, psq, psk, has_bias, has_segs,
+                               has_dropout),
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+            pl.BlockSpec((1, cfg.block_q, d), lambda i, j, kb: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, cfg.block_q), lambda i, j, kb: (i, 0, j)),
         ],
         out_shape=[
-            shape_struct((bh, psq, d), q.dtype, qp, kp, vp),
-            shape_struct((bh, 1, psq), jnp.float32, qp, kp, vp),
+            shape_struct((bh, psq, d), q.dtype, q, k, v),
+            shape_struct((bh, 1, psq), jnp.float32, q, k, v),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((cfg.block_q, d), jnp.float32),
+            pltpu.VMEM((cfg.block_q, _LANES), jnp.float32),
+            pltpu.VMEM((cfg.block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(qp, kp, vp)
-    lse = lse[:, 0]
-    if pad_q:
-        out, lse = out[:, :sq], lse[:, :sq]
-    return out, lse
+    )(*inputs)
+    return out, lse[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -187,189 +381,276 @@ def _fa_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k):
 
 
 def _fa_bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, sm_scale, causal, block_q, block_k, q_len,
+    *refs, cfg: _FAConfig, num_q: int, has_bias, has_segs, has_dropout,
 ):
-    kb = pl.program_id(1)
-    kblk = k_ref[0].astype(jnp.float32)                   # (block_k, d)
-    vblk = v_ref[0].astype(jnp.float32)
-    d = kblk.shape[-1]
-    num_q = pl.cdiv(q_len, block_q)
-    start_q = 0
-    if causal:
-        start_q = (kb * block_k) // block_q
+    (q_ref, k_ref, v_ref), rest = refs[:3], refs[3:]
+    bias_ref = qseg_ref = kseg_ref = seed_ref = None
+    if has_bias:
+        bias_ref, rest = rest[0], rest[1:]
+    if has_segs:
+        (qseg_ref, kseg_ref), rest = rest[:2], rest[2:]
+    if has_dropout:
+        seed_ref, rest = rest[0], rest[1:]
+    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
 
-    def body(jq, carry):
-        dk, dv = carry
-        qblk = q_ref[0, pl.ds(jq * block_q, block_q), :].astype(jnp.float32)
-        doblk = do_ref[0, pl.ds(jq * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(jq * block_q, block_q)][:, None]
-        delta = delta_ref[0, 0, pl.ds(jq * block_q, block_q)][:, None]
+    i, kb, jq = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    block_q, block_k = cfg.block_q, cfg.block_k
+    # under causal masking, q blocks strictly above the diagonal band
+    # contribute nothing to this k block
+    first_jq = (kb * block_k) // block_q if cfg.causal else 0
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    @pl.when(jq >= first_jq)
+    def _compute():
+        kblk = k_ref[0].astype(jnp.float32)                # (block_k, d)
+        vblk = v_ref[0].astype(jnp.float32)
+        qblk = q_ref[0].astype(jnp.float32)                # (block_q, d)
+        doblk = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(
             qblk, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * sm_scale                                      # (block_q, block_k)
+        ) * cfg.sm_scale                                   # (block_q, block_k)
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         q_global = jq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 0
         )
         k_global = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1
         )
-        mask = q_global < q_len
-        if causal:
+        mask = jnp.logical_and(q_global < cfg.q_len, k_global < cfg.kv_len)
+        if cfg.causal:
             mask = jnp.logical_and(mask, k_global <= q_global)
+        if has_segs:
+            mask = jnp.logical_and(
+                mask, qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
+            )
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dv = dv + jax.lax.dot_general(
-            p, doblk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
         dp = jax.lax.dot_general(
             doblk, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * sm_scale
-        dk = dk + jax.lax.dot_general(
-            ds, qblk, (((0,), (0,)), ((), ())),
+        if has_dropout:
+            keep = _keep_mask(
+                seed_ref[0, 0], i, q_global, k_global,
+                jnp.uint32(_keep_threshold(cfg.dropout_rate)),
+            )
+            inv_kp = 1.0 / (1.0 - cfg.dropout_rate)
+            p_drop = jnp.where(keep, p, 0.0) * inv_kp
+            dp = jnp.where(keep, dp, 0.0) * inv_kp
+        else:
+            p_drop = p
+        dv_acc[...] += jax.lax.dot_general(
+            p_drop, doblk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk, dv
+        dz = p * (dp - delta)                              # grad wrt s+bias
+        dk_acc[...] += jax.lax.dot_general(
+            dz * cfg.sm_scale, qblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-    dk0 = jnp.zeros((kblk.shape[0], d), jnp.float32)
-    dv0 = jnp.zeros((vblk.shape[0], d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start_q, num_q, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(jq == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _fa_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, sm_scale, causal, block_q, block_k, kv_len,
+    *refs, cfg: _FAConfig, num_k: int, has_bias, has_segs, has_dropout,
 ):
-    j = pl.program_id(1)
-    qblk = q_ref[0].astype(jnp.float32)                   # (block_q, d)
-    doblk = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
-    d = qblk.shape[-1]
-    num_k = pl.cdiv(kv_len, block_k)
-    if causal:
-        num_k = jnp.minimum(num_k, pl.cdiv((j + 1) * block_q, block_k))
+    (q_ref, k_ref, v_ref), rest = refs[:3], refs[3:]
+    bias_ref = qseg_ref = kseg_ref = seed_ref = None
+    if has_bias:
+        bias_ref, rest = rest[0], rest[1:]
+    if has_segs:
+        (qseg_ref, kseg_ref), rest = rest[:2], rest[2:]
+    if has_dropout:
+        seed_ref, rest = rest[0], rest[1:]
+    if has_bias and cfg.bias_grad:
+        do_ref, lse_ref, delta_ref, dq_ref, dbias_ref, dq_acc = rest
+    else:
+        do_ref, lse_ref, delta_ref, dq_ref, dq_acc = rest
+        dbias_ref = None
 
-    def body(kb, dq):
-        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    i, j, kb = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    block_q, block_k = cfg.block_q, cfg.block_k
+    if cfg.causal:
+        last_kb = jnp.minimum(num_k - 1, ((j + 1) * block_q - 1) // block_k)
+    else:
+        last_kb = num_k - 1
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    # with a bias gradient every block must be written, so the causal
+    # block-skip optimization only applies when dbias is not emitted
+    # (masking keeps the skipped blocks' contributions at exactly zero
+    # either way)
+    emit_dbias = dbias_ref is not None
+    run = (kb <= last_kb) if not emit_dbias else (kb <= num_k - 1)
+
+    @pl.when(run)
+    def _compute():
+        qblk = q_ref[0].astype(jnp.float32)
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        doblk = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(
             qblk, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * sm_scale
+        ) * cfg.sm_scale
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
+        q_global = j * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0
+        )
         k_global = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1
         )
-        mask = k_global < kv_len
-        if causal:
-            q_global = j * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0
-            )
+        mask = k_global < cfg.kv_len
+        if cfg.causal:
             mask = jnp.logical_and(mask, k_global <= q_global)
+        if has_segs:
+            mask = jnp.logical_and(
+                mask, qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
+            )
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             doblk, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * sm_scale
-        return dq + jax.lax.dot_general(
-            ds, kblk, (((1,), (0,)), ((), ())),
+        if has_dropout:
+            keep = _keep_mask(
+                seed_ref[0, 0], i, q_global, k_global,
+                jnp.uint32(_keep_threshold(cfg.dropout_rate)),
+            )
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - cfg.dropout_rate))
+        dz = p * (dp - delta)
+        if emit_dbias:
+            dbias_ref[0] = dz.astype(dbias_ref.dtype)
+        dq_acc[...] += jax.lax.dot_general(
+            dz * cfg.sm_scale, kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    dq = jax.lax.fori_loop(
-        0, num_k, body, jnp.zeros((qblk.shape[0], d), jnp.float32)
-    )
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    write_kb = (num_k - 1) if emit_dbias else last_kb
+
+    @pl.when(kb == write_kb)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _fa_bwd_pallas(q, k, v, out, lse, do, sm_scale, causal,
-                   block_q, block_k):
-    bh, sq, d = q.shape
-    kv_len = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, kv_len)
-    pad_q = (-sq) % block_q
-    pad_k = (-kv_len) % block_k
+def _fa_bwd_pallas(q, k, v, bias, qseg, kseg, seed, out, lse, do,
+                   cfg: _FAConfig):
+    bh, psq, d = q.shape
+    psk = k.shape[1]
+    num_q, num_k = psq // cfg.block_q, psk // cfg.block_k
+    has_bias = bias is not None
+    has_segs = qseg is not None
+    has_dropout = cfg.dropout_rate > 0.0
     # delta = rowsum(do * o) — cheap, XLA fuses it
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )
-    padq = lambda x: jnp.pad(x, ((0, 0), (0, pad_q), (0, 0))) if pad_q else x
-    padk = lambda x: jnp.pad(x, ((0, 0), (0, pad_k), (0, 0))) if pad_k else x
-    qp, dop = padq(q), padq(do)
-    kp, vp = padk(k), padk(v)
-    lsep = jnp.pad(lse, ((0, 0), (0, pad_q))) if pad_q else lse
-    deltap = jnp.pad(delta, ((0, 0), (0, pad_q))) if pad_q else delta
-    lsep = lsep[:, None, :]
-    deltap = deltap[:, None, :]
-    psq, psk = sq + pad_q, kv_len + pad_k
+    lse3 = lse[:, None, :]
+    delta3 = delta[:, None, :]
+
+    common = [q, k, v]
+    if has_bias:
+        common.append(bias)
+    if has_segs:
+        common.extend([qseg, kseg])
+    if has_dropout:
+        common.append(seed)
+
+    def dkv_specs():
+        specs = _fwd_in_specs(cfg, d, psq, psk, has_bias, has_segs,
+                              has_dropout, swap_grid=True)
+        specs.extend([
+            pl.BlockSpec((1, cfg.block_q, d), lambda i, kb, jq: (i, jq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, cfg.block_q), lambda i, kb, jq: (i, 0, jq)),
+            pl.BlockSpec((1, 1, cfg.block_q), lambda i, kb, jq: (i, 0, jq)),
+        ])
+        return specs
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _fa_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, q_len=sq,
+            _fa_bwd_dkv_kernel, cfg=cfg, num_q=num_q, has_bias=has_bias,
+            has_segs=has_segs, has_dropout=has_dropout,
         ),
-        grid=(bh, psk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, psq, d), lambda i, kb: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, psq, d), lambda i, kb: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, psq), lambda i, kb: (i, 0, 0)),
-            pl.BlockSpec((1, 1, psq), lambda i, kb: (i, 0, 0)),
-        ],
+        grid=(bh, num_k, num_q),
+        in_specs=dkv_specs(),
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+            pl.BlockSpec((1, cfg.block_k, d), lambda i, kb, jq: (i, kb, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+            pl.BlockSpec((1, cfg.block_k, d), lambda i, kb, jq: (i, kb, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            shape_struct((bh, psk, d), k.dtype, qp, kp, vp, dop),
-            shape_struct((bh, psk, d), v.dtype, qp, kp, vp, dop),
+            shape_struct((bh, psk, d), k.dtype, q, k, v, do),
+            shape_struct((bh, psk, d), v.dtype, q, k, v, do),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((cfg.block_k, d), jnp.float32),
+            pltpu.VMEM((cfg.block_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*common, do, lse3, delta3)
 
-    dq = pl.pallas_call(
+    emit_dbias = has_bias and cfg.bias_grad
+    dq_out_specs = [
+        pl.BlockSpec((1, cfg.block_q, d), lambda i, j, kb: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    dq_out_shape = [shape_struct((bh, psq, d), q.dtype, q, k, v, do)]
+    if emit_dbias:
+        dq_out_specs.append(
+            pl.BlockSpec((1, cfg.block_q, cfg.block_k),
+                         lambda i, j, kb: (i, j, kb),
+                         memory_space=pltpu.VMEM)
+        )
+        dq_out_shape.append(
+            shape_struct((bh, psq, psk), jnp.float32, q, k, v, do)
+        )
+
+    dq_specs = _fwd_in_specs(cfg, d, psq, psk, has_bias, has_segs,
+                             has_dropout)
+    dq_specs.extend([
+        pl.BlockSpec((1, cfg.block_q, d), lambda i, j, kb: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, cfg.block_q), lambda i, j, kb: (i, 0, j)),
+        pl.BlockSpec((1, 1, cfg.block_q), lambda i, j, kb: (i, 0, j)),
+    ])
+    res = pl.pallas_call(
         functools.partial(
-            _fa_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, kv_len=kv_len,
+            _fa_bwd_dq_kernel, cfg=cfg, num_k=num_k, has_bias=has_bias,
+            has_segs=has_segs, has_dropout=has_dropout,
         ),
-        grid=(bh, psq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, psk, d), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, psk, d), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=shape_struct((bh, psq, d), q.dtype, qp, kp, vp, dop),
+        grid=(bh, num_q, num_k),
+        in_specs=dq_specs,
+        out_specs=dq_out_specs if emit_dbias else dq_out_specs[0],
+        out_shape=dq_out_shape if emit_dbias else dq_out_shape[0],
+        compiler_params=_compiler_params(),
+        scratch_shapes=[pltpu.VMEM((cfg.block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
-
-    if pad_q:
-        dq = dq[:, :sq]
-    if pad_k:
-        dk, dv = dk[:, :kv_len], dv[:, :kv_len]
-    return dq, dk, dv
+    )(*common, do, lse3, delta3)
+    if emit_dbias:
+        dq, dbias = res
+    else:
+        dq, dbias = res, None
+    return dq, dk, dv, dbias
 
 
 # ---------------------------------------------------------------------------
@@ -377,26 +658,61 @@ def _fa_bwd_pallas(q, k, v, out, lse, do, sm_scale, causal,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k):
-    out, _ = _fa_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def _flash(q, k, v, bias, qseg, kseg, seed, cfg):
+    out, _ = _fa_fwd_pallas(q, k, v, bias, qseg, kseg, seed, cfg)
     return out
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    out, lse = _fa_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, bias, qseg, kseg, seed, cfg):
+    out, lse = _fa_fwd_pallas(q, k, v, bias, qseg, kseg, seed, cfg)
+    return out, (q, k, v, bias, qseg, kseg, seed, out, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, res, do):
-    q, k, v, out, lse = res
-    dq, dk, dv = _fa_bwd_pallas(
-        q, k, v, out, lse, do, sm_scale, causal, block_q, block_k
+def _int_zero(x):
+    return (
+        None if x is None
+        else np.zeros(x.shape, jax.dtypes.float0)
     )
-    return dq, dk, dv
+
+
+def _flash_bwd(cfg, res, do):
+    q, k, v, bias, qseg, kseg, seed, out, lse = res
+    dq, dk, dv, dbias = _fa_bwd_pallas(
+        q, k, v, bias, qseg, kseg, seed, out, lse, do, cfg
+    )
+    if bias is not None and not cfg.bias_grad:
+        # constant-mask contract: caller declared the bias non-trainable
+        dbias = jnp.zeros_like(bias)
+    elif bias is not None:
+        # the kernel emits per-(b*h) score grads; fold back to the
+        # flattened-bias batching the primal used
+        bh, psq, psk = dbias.shape
+        if cfg.bias_batch == 1:
+            dbias = jnp.sum(dbias, axis=0, keepdims=True)
+        elif cfg.bias_batch == BIAS_PER_BATCH:
+            dbias = dbias.reshape(
+                bh // cfg.heads, cfg.heads, psq, psk
+            ).sum(axis=1)
+        dbias = dbias.astype(bias.dtype)
+    return (dq, dk, dv, dbias, _int_zero(qseg), _int_zero(kseg),
+            _int_zero(seed))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public entry point
+# ---------------------------------------------------------------------------
+
+
+def _pad_seq(x, pad, axis=1):
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
 
 
 def flash_attention(
@@ -406,6 +722,11 @@ def flash_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     bias: Optional[jnp.ndarray] = None,
+    q_segment_ids: Optional[jnp.ndarray] = None,
+    kv_segment_ids: Optional[jnp.ndarray] = None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
+    bias_requires_grad: bool = True,
     block_q: int = 256,
     block_k: int = 256,
     implementation: Optional[str] = None,
@@ -414,23 +735,119 @@ def flash_attention(
 
     ``implementation`` is ``"pallas"`` (TPU kernel) or ``"xla"``
     (reference path, also the CPU fallback); default picks by platform.
-    ``bias`` (additive mask) currently routes to the XLA path.
+
+    ``bias`` is an additive score bias broadcastable from
+    ``(1|b, 1|h, sq, sk)``; it is differentiable by default (the backward
+    pass then materialises per-head score-grad blocks, so prefer
+    ``segment_ids`` over huge bias masks for long-sequence varlen).
+    Pass ``bias_requires_grad=False`` for constant masks: the bias
+    cotangent is then hard zero and the backward keeps the pure
+    flash-attention memory profile.
+    ``q_segment_ids``/``kv_segment_ids`` are ``(b, sq)``/``(b, sk)``
+    int32 tokens-attend-within-equal-id masks — the TPU-native varlen
+    API (reference: cu_seqlens, apex/contrib/fmha/fmha.py:33-80).
+    ``dropout_rate``/``dropout_seed`` apply probability dropout inside
+    the kernel with a counter-based hash (reference: philox.h) that the
+    backward pass replays exactly; the same seed on the XLA path draws
+    the identical mask.
     """
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("segment ids must be given for both q and kv")
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
+    if bias is not None and bias.ndim < 4:
+        bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+    from apex_tpu.ops.common import KernelLoweringError, run_kernel
+
+    if pl is None and implementation == "pallas":
+        raise KernelLoweringError(
+            "implementation='pallas' requested but Pallas failed to import"
+        )
     impl = implementation or default_implementation()
-    if impl != "pallas" or pl is None or bias is not None:
-        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
-                             bias=bias)
+    if pl is None:
+        impl = "xla"
+
+    def _xla_path():
+        return mha_reference(
+            q, k, v, causal=causal, sm_scale=sm_scale, bias=bias,
+            q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+        )
+
+    def _pallas_path():
+        return _flash_attention_pallas(
+            q, k, v, causal, sm_scale, bias, q_segment_ids,
+            kv_segment_ids, dropout_rate, dropout_seed,
+            bias_requires_grad, block_q, block_k,
+        )
+
+    return run_kernel(
+        "flash_attention", _pallas_path, _xla_path, implementation, impl
+    )
+
+
+def _flash_attention_pallas(
+    q, k, v, causal, sm_scale, bias, q_segment_ids, kv_segment_ids,
+    dropout_rate, dropout_seed, bias_requires_grad, block_q, block_k,
+):
     b, h, sq, d = q.shape
+    sk = k.shape[2]
     scale = (1.0 / d**0.5) if sm_scale is None else float(sm_scale)
+    block_q = min(block_q, max(sq, 1))
+    block_k = min(block_k, max(sk, 1))
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
     # pad head_dim to the 128-lane tile; zero columns do not change
     # q@k^T, and padded output columns are sliced off
-    pad_d = (-d) % 128
+    pad_d = (-d) % _LANES
     if pad_d:
         padd = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, pad_d)))
         q, k, v = padd(q), padd(k), padd(v)
+
     flat = lambda x: x.reshape(b * h, x.shape[2], x.shape[3])
-    out = _flash(flat(q), flat(k), flat(v), scale, causal,
-                 block_q, block_k)
+    qf = _pad_seq(flat(q), pad_q)
+    kf = _pad_seq(flat(k), pad_k)
+    vf = _pad_seq(flat(v), pad_k)
+
+    bias_flat = None
+    bias_batch = 0
+    if bias is not None:
+        bb, bs_h, bsq, bsk = bias.shape
+        bias4 = jnp.broadcast_to(bias, (bb, bs_h, sq, sk))
+        if bb == 1 and bs_h == 1:
+            bias_flat, bias_batch = bias4.reshape(1, sq, sk), 1
+        elif bs_h == 1:
+            bias_flat, bias_batch = bias4.reshape(b, sq, sk), BIAS_PER_BATCH
+        else:
+            bias4 = jnp.broadcast_to(bias, (b, h, sq, sk))
+            bias_flat = bias4.reshape(b * h, sq, sk)
+            bias_batch = BIAS_PER_HEAD
+        bias_flat = _pad_seq(_pad_seq(bias_flat, pad_q, axis=1), pad_k, axis=2)
+
+    qseg = kseg = None
+    if q_segment_ids is not None:
+        qseg = _pad_seq(q_segment_ids.astype(jnp.int32), pad_q, axis=1)
+        # padded kv positions are masked by kv_len already; pad ids with -1
+        # so they also never match a real segment
+        kseg = jnp.pad(
+            kv_segment_ids.astype(jnp.int32), ((0, 0), (0, pad_k)),
+            constant_values=-1,
+        ) if pad_k else kv_segment_ids.astype(jnp.int32)
+        # (b, 1, s): the singleton keeps the trailing block dims tileable
+        qseg, kseg = qseg[:, None, :], kseg[:, None, :]
+
+    seed_arr = None
+    if dropout_rate > 0.0:
+        seed_arr = jnp.asarray(dropout_seed, jnp.uint32).reshape(1, 1)
+
+    cfg = _FAConfig(
+        sm_scale=scale, causal=causal, dropout_rate=float(dropout_rate),
+        block_q=block_q, block_k=block_k, q_len=sq, kv_len=sk, heads=h,
+        bias_batch=bias_batch, bias_grad=bool(bias_requires_grad),
+    )
+    out = _flash(qf, kf, vf, bias_flat, qseg, kseg, seed_arr, cfg)
+    if pad_q:
+        out = out[:, :sq]
     out = out.reshape(b, h, sq, d + pad_d)
     if pad_d:
         out = out[..., :d]

@@ -2,9 +2,55 @@
 
 from __future__ import annotations
 
+import logging
+import os
+
 import jax
 
-__all__ = ["shape_struct"]
+__all__ = ["shape_struct", "run_kernel", "KernelLoweringError"]
+
+_logger = logging.getLogger("apex_tpu")
+
+
+class KernelLoweringError(RuntimeError):
+    """A Pallas kernel failed to trace/lower on a path where falling back
+    silently is not allowed (explicit ``implementation='pallas'`` or
+    ``APEX_TPU_STRICT_KERNELS=1``)."""
+
+
+def run_kernel(name, pallas_fn, xla_fn, requested_impl, resolved_impl):
+    """Dispatch between a Pallas kernel and its XLA fallback.
+
+    Fallback policy (the assertable contract the reference gets from its
+    import-time extension probing, apex/parallel/distributed.py:13-23):
+
+    - ``requested_impl == "pallas"``: the user asked for the kernel —
+      a lowering failure RAISES ``KernelLoweringError`` instead of
+      silently degrading.
+    - auto mode (``requested_impl is None``): a failure falls back to
+      XLA with a logged warning, unless ``APEX_TPU_STRICT_KERNELS=1``
+      makes every fallback an error (CI smoke mode).
+    """
+    if resolved_impl != "pallas":
+        return xla_fn()
+    strict = (
+        requested_impl == "pallas"
+        or bool(os.environ.get("APEX_TPU_STRICT_KERNELS"))
+    )
+    try:
+        return pallas_fn()
+    except Exception as e:  # trace-time shape/lowering rejection
+        if strict:
+            raise KernelLoweringError(
+                f"pallas kernel {name!r} failed to lower and strict mode "
+                f"is on (explicit implementation='pallas' or "
+                f"APEX_TPU_STRICT_KERNELS=1): {e}"
+            ) from e
+        _logger.warning(
+            "pallas kernel %s unavailable (%s); falling back to XLA",
+            name, e,
+        )
+        return xla_fn()
 
 
 def shape_struct(shape, dtype, *varying_like) -> jax.ShapeDtypeStruct:

@@ -25,7 +25,7 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.common import shape_struct
+from apex_tpu.ops.common import run_kernel, shape_struct
 
 from apex_tpu.utils.platform import default_implementation, is_tpu
 
@@ -124,13 +124,13 @@ def _ln_fwd_xla(x2d: jnp.ndarray, eps: float, rms: bool):
 
 
 def _ln_fwd(x2d, eps, rms, implementation: Optional[str]):
-    impl = implementation or default_implementation()
-    if impl == "pallas":
-        try:
-            return _ln_fwd_pallas(x2d, eps, rms)
-        except Exception:
-            return _ln_fwd_xla(x2d, eps, rms)
-    return _ln_fwd_xla(x2d, eps, rms)
+    return run_kernel(
+        "fused_layer_norm",
+        lambda: _ln_fwd_pallas(x2d, eps, rms),
+        lambda: _ln_fwd_xla(x2d, eps, rms),
+        implementation,
+        implementation or default_implementation(),
+    )
 
 
 # ---------------------------------------------------------------------------

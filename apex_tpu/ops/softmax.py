@@ -28,7 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.common import shape_struct
+from apex_tpu.ops.common import run_kernel, shape_struct
 from apex_tpu.utils.platform import default_implementation
 
 __all__ = [
@@ -132,18 +132,26 @@ def _softmax_fwd_xla(
 
 
 def _softmax_fwd(x3d, mask, scale, causal, implementation):
-    impl = implementation or default_implementation()
-    if impl == "pallas" and mask is None and pl is not None:
-        try:
-            return _softmax_fwd_pallas(x3d, scale, causal)
-        except Exception as e:  # trace-time shape/lowering rejection
-            import logging
+    from apex_tpu.ops.common import KernelLoweringError
 
-            logging.getLogger("apex_tpu").warning(
-                "pallas softmax unavailable for shape %s (%s); "
-                "falling back to XLA", x3d.shape, e,
-            )
-    return _softmax_fwd_xla(x3d, scale, causal, mask)
+    if pl is None and implementation == "pallas":
+        raise KernelLoweringError(
+            "implementation='pallas' requested but Pallas failed to import"
+        )
+    impl = implementation or default_implementation()
+    if mask is not None or pl is None:
+        # the padded-mask variant is XLA-only by design: XLA fuses the
+        # mask+softmax chain optimally, and the arbitrary-mask fast path
+        # in this library is the flash-attention kernel's segment-id /
+        # bias support, not this op
+        impl = "xla"
+    return run_kernel(
+        "scaled_softmax",
+        lambda: _softmax_fwd_pallas(x3d, scale, causal),
+        lambda: _softmax_fwd_xla(x3d, scale, causal, mask),
+        implementation if mask is None else None,
+        impl,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -309,3 +309,22 @@ class TestFMHA:
                 np.asarray(jnp.moveaxis(got, 0, 1)), np.asarray(expected),
                 rtol=1e-5, atol=1e-6,
             )
+
+    def test_varlen_flash_kernel_path(self):
+        """fmha must ride the flash kernel (not a dense fallback):
+        forced-pallas output equals the XLA route bit-for-tolerance."""
+        rng = np.random.default_rng(1)
+        heads, d = 2, 32
+        lens = [7, 12, 4]
+        cu = jnp.asarray(np.cumsum([0] + lens).astype(np.int32))
+        total = sum(lens)
+        qkv = jnp.asarray(
+            rng.normal(size=(total, 3, heads, d)).astype(np.float32)
+        )
+        got = fmha(qkv, cu, max_seq_len=16, causal=True,
+                   implementation="pallas")
+        want = fmha(qkv, cu, max_seq_len=16, causal=True,
+                    implementation="xla")
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )

@@ -150,3 +150,53 @@ class TestModules:
         assert "bias" not in params["params"]
         out = m.apply(params, x)
         assert out.shape == (2, 16)
+
+
+class TestKernelFallbackPolicy:
+    """A Pallas lowering failure must be loud where it matters
+    (VERDICT r2: no silent kernel regressions)."""
+
+    def _broken(self, monkeypatch):
+        from apex_tpu.ops import layer_norm as ln
+
+        def boom(*a, **k):
+            raise RuntimeError("mosaic lowering exploded")
+
+        monkeypatch.setattr(ln, "_ln_fwd_pallas", boom)
+
+    def test_explicit_pallas_raises(self, monkeypatch):
+        from apex_tpu.ops.common import KernelLoweringError
+
+        self._broken(monkeypatch)
+        x = jnp.ones((4, 64))
+        with pytest.raises(KernelLoweringError):
+            fused_layer_norm(x, 64, implementation="pallas")
+
+    def test_strict_env_raises_in_auto_mode(self, monkeypatch):
+        from apex_tpu.ops.common import KernelLoweringError
+        from apex_tpu.utils import platform as plat
+
+        self._broken(monkeypatch)
+        # force auto-mode to resolve to pallas as it would on TPU
+        monkeypatch.setattr(plat, "_current_platform", lambda: "tpu")
+        monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
+        monkeypatch.setenv("APEX_TPU_STRICT_KERNELS", "1")
+        x = jnp.ones((4, 64))
+        with pytest.raises(KernelLoweringError):
+            fused_layer_norm(x, 64, implementation=None)
+
+    def test_auto_mode_falls_back_with_warning(self, monkeypatch, caplog):
+        import logging
+
+        from apex_tpu.utils import platform as plat
+
+        self._broken(monkeypatch)
+        monkeypatch.setattr(plat, "_current_platform", lambda: "tpu")
+        monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
+        monkeypatch.delenv("APEX_TPU_STRICT_KERNELS", raising=False)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64))
+        with caplog.at_level(logging.WARNING, logger="apex_tpu"):
+            out = fused_layer_norm(x, 64, implementation=None)
+        assert any("falling back to XLA" in r.message for r in caplog.records)
+        want = fused_layer_norm(x, 64, implementation="xla")
+        np.testing.assert_allclose(out, want, atol=1e-6)

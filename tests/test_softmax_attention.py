@@ -234,3 +234,210 @@ class TestFlashAttention:
         got = flash_attention(q, k, v, bias=bias)
         want = mha_reference(q, k, v, bias=bias)
         np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestFlashAttentionExtras:
+    """New in-kernel capabilities: segment ids (varlen), differentiable
+    additive bias, and counter-based dropout — each checked pallas-vs-xla
+    in interpret mode (the two paths share the dropout hash, so dropout
+    comparisons are exact, not statistical)."""
+
+    def _qkv(self, key, shape):
+        kq, kk, kv = jax.random.split(key, 3)
+        return (jax.random.normal(kq, shape), jax.random.normal(kk, shape),
+                jax.random.normal(kv, shape))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_segment_ids_match_reference(self, causal):
+        q, k, v = self._qkv(jax.random.PRNGKey(30), (2, 2, 96, 128))
+        # two packed sequences of 40 + 56 tokens per batch row
+        seg = jnp.concatenate(
+            [jnp.zeros((2, 40), jnp.int32), jnp.ones((2, 56), jnp.int32)],
+            axis=1,
+        )
+        got = flash_attention(
+            q, k, v, causal=causal, q_segment_ids=seg, kv_segment_ids=seg,
+            block_q=64, block_k=64, implementation="pallas",
+        )
+        want = mha_reference(
+            q, k, v, causal=causal, q_segment_ids=seg, kv_segment_ids=seg
+        )
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_segment_ids_gradients(self):
+        q, k, v = self._qkv(jax.random.PRNGKey(31), (1, 2, 64, 128))
+        seg = (jnp.arange(64) // 24).astype(jnp.int32)[None, :]
+
+        def f(impl):
+            def loss(q, k, v):
+                return jnp.sum(flash_attention(
+                    q, k, v, causal=True, q_segment_ids=seg,
+                    kv_segment_ids=seg, block_q=32, block_k=32,
+                    implementation=impl,
+                ) ** 2)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        for a, b in zip(f("pallas"), f("xla")):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+    @pytest.mark.parametrize(
+        "bias_shape", [(1, 1, 64, 64), (2, 1, 64, 64), (2, 2, 64, 64)]
+    )
+    def test_bias_broadcast_and_grad(self, bias_shape):
+        q, k, v = self._qkv(jax.random.PRNGKey(32), (2, 2, 64, 128))
+        bias = jax.random.normal(jax.random.PRNGKey(33), bias_shape)
+
+        def loss(impl):
+            def f(q, k, v, bias):
+                return jnp.sum(flash_attention(
+                    q, k, v, bias=bias, block_q=32, block_k=32,
+                    implementation=impl,
+                ) ** 2)
+            return f
+
+        got = flash_attention(q, k, v, bias=bias, block_q=32, block_k=32,
+                              implementation="pallas")
+        want = mha_reference(q, k, v, bias=bias)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+        g1 = jax.grad(loss("pallas"), argnums=(0, 1, 2, 3))(q, k, v, bias)
+        g2 = jax.grad(loss("xla"), argnums=(0, 1, 2, 3))(q, k, v, bias)
+        for a, b in zip(g1, g2):
+            assert a.shape == b.shape
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+    def test_bias_with_causal_grad(self):
+        q, k, v = self._qkv(jax.random.PRNGKey(34), (1, 2, 48, 128))
+        bias = jax.random.normal(jax.random.PRNGKey(35), (1, 2, 48, 48))
+
+        def loss(impl):
+            def f(q, k, v, bias):
+                return jnp.sum(flash_attention(
+                    q, k, v, bias=bias, causal=True, block_q=16, block_k=16,
+                    implementation=impl,
+                ) ** 2)
+            return f
+
+        g1 = jax.grad(loss("pallas"), argnums=(0, 1, 2, 3))(q, k, v, bias)
+        g2 = jax.grad(loss("xla"), argnums=(0, 1, 2, 3))(q, k, v, bias)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+    def test_dropout_exact_parity_and_rate(self):
+        q, k, v = self._qkv(jax.random.PRNGKey(36), (2, 2, 64, 128))
+        got = flash_attention(
+            q, k, v, dropout_rate=0.3, dropout_seed=1234,
+            block_q=32, block_k=32, implementation="pallas",
+        )
+        want = flash_attention(
+            q, k, v, dropout_rate=0.3, dropout_seed=1234,
+            implementation="xla",
+        )
+        # same hash, same seed → identical mask → near-identical values
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # deterministic given the seed
+        again = flash_attention(
+            q, k, v, dropout_rate=0.3, dropout_seed=1234,
+            block_q=32, block_k=32, implementation="pallas",
+        )
+        np.testing.assert_allclose(got, again, atol=0)
+        # different seed → different output
+        other = flash_attention(
+            q, k, v, dropout_rate=0.3, dropout_seed=99,
+            block_q=32, block_k=32, implementation="pallas",
+        )
+        assert float(jnp.max(jnp.abs(got - other))) > 1e-3
+
+    def test_dropout_mask_statistics(self):
+        from apex_tpu.ops.attention import _keep_mask, _keep_threshold
+
+        q_idx = jax.lax.broadcasted_iota(jnp.int32, (256, 256), 0)
+        k_idx = jax.lax.broadcasted_iota(jnp.int32, (256, 256), 1)
+        keep = _keep_mask(jnp.uint32(5), jnp.int32(3), q_idx, k_idx,
+                          jnp.uint32(_keep_threshold(0.25)))
+        frac = float(jnp.mean(keep.astype(jnp.float32)))
+        assert abs(frac - 0.75) < 0.02
+
+    def test_dropout_gradients_match_reference(self):
+        q, k, v = self._qkv(jax.random.PRNGKey(37), (1, 2, 64, 128))
+
+        def loss(impl):
+            def f(q, k, v):
+                return jnp.sum(flash_attention(
+                    q, k, v, causal=True, dropout_rate=0.2, dropout_seed=7,
+                    block_q=32, block_k=32, implementation=impl,
+                ) ** 2)
+            return f
+
+        g1 = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss("xla"), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+    def test_everything_composes(self):
+        # segments + bias + dropout + causal + ragged seq in one call
+        q, k, v = self._qkv(jax.random.PRNGKey(38), (2, 2, 50, 128))
+        seg = (jnp.arange(50) // 20).astype(jnp.int32)[None, :].repeat(2, 0)
+        bias = 0.1 * jax.random.normal(jax.random.PRNGKey(39), (2, 1, 50, 50))
+        kwargs = dict(
+            causal=True, bias=bias, q_segment_ids=seg, kv_segment_ids=seg,
+            dropout_rate=0.1, dropout_seed=42,
+        )
+        got = flash_attention(q, k, v, block_q=16, block_k=16,
+                              implementation="pallas", **kwargs)
+        want = flash_attention(q, k, v, implementation="xla", **kwargs)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_large_uint32_seed(self):
+        q, k, v = self._qkv(jax.random.PRNGKey(40), (1, 1, 32, 128))
+        got = flash_attention(q, k, v, dropout_rate=0.2,
+                              dropout_seed=0xDEADBEEF, block_q=16,
+                              block_k=16, implementation="pallas")
+        want = flash_attention(q, k, v, dropout_rate=0.2,
+                               dropout_seed=0xDEADBEEF,
+                               implementation="xla")
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_sub_4d_bias(self):
+        q, k, v = self._qkv(jax.random.PRNGKey(41), (2, 2, 16, 128))
+        bias = jax.random.normal(jax.random.PRNGKey(42), (16, 16))
+        got = flash_attention(q, k, v, bias=bias, block_q=16, block_k=16,
+                              implementation="pallas")
+        want = mha_reference(q, k, v, bias=bias[None, None])
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_constant_mask_bias_skips_dbias(self):
+        q, k, v = self._qkv(jax.random.PRNGKey(43), (1, 2, 32, 128))
+        bias = jnp.where(
+            jax.random.bernoulli(jax.random.PRNGKey(44), 0.8, (1, 1, 32, 32)),
+            0.0, -1e30,
+        )
+
+        def loss(q, k, v, bias):
+            return jnp.sum(flash_attention(
+                q, k, v, bias=bias, bias_requires_grad=False,
+                causal=True, block_q=16, block_k=16,
+                implementation="pallas",
+            ) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        # q/k/v grads match the XLA path; bias cotangent is hard zero
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, bias=bias, causal=True) ** 2)
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g[:3], gr):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+        np.testing.assert_allclose(g[3], 0.0, atol=0)
+
+    def test_explicit_pallas_raises_without_pallas(self, monkeypatch):
+        from apex_tpu.ops import attention as attn_mod
+        from apex_tpu.ops.common import KernelLoweringError
+
+        q = k = v = jnp.ones((1, 1, 8, 8))
+        monkeypatch.setattr(attn_mod, "pl", None)
+        with pytest.raises(KernelLoweringError):
+            attn_mod.flash_attention(q, k, v, implementation="pallas")
+        # auto mode still degrades gracefully
+        out = attn_mod.flash_attention(q, k, v)
+        assert out.shape == (1, 1, 8, 8)
