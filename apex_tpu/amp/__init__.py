@@ -40,6 +40,16 @@ from apex_tpu.amp.scaler import (  # noqa: F401
     all_finite,
     scale_gradients,
 )
+from apex_tpu.amp.lists import (  # noqa: F401
+    FP32_NN,
+    FP32_NUMPY,
+    LOW_PRECISION_LAX,
+    LOW_PRECISION_NUMPY,
+    PROMOTE_NUMPY,
+    SEQUENCE_NUMPY,
+    cast_namespaces,
+    patch,
+)
 from apex_tpu.amp.functional import (  # noqa: F401
     bfloat16_function,
     float_function,
@@ -71,6 +81,14 @@ __all__ = [
     "register_float_function",
     "register_promote_function",
     "set_low_precision_dtype",
+    "LOW_PRECISION_NUMPY",
+    "LOW_PRECISION_LAX",
+    "FP32_NUMPY",
+    "FP32_NN",
+    "PROMOTE_NUMPY",
+    "SEQUENCE_NUMPY",
+    "cast_namespaces",
+    "patch",
 ]
 
 
@@ -110,11 +128,21 @@ class MixedPrecision:
         return self.scaler.scale(state.scaler_states[loss_id], loss)
 
     def unscale_and_adjust(
-        self, state: AmpState, grads: Any, loss_id: int = 0
+        self, state: AmpState, grads: Any, loss_id: int = 0,
+        finite_reduce=None,
     ) -> Tuple[Any, jnp.ndarray, AmpState]:
-        grads, finite, new_sstate = self.scaler.unscale_and_adjust(
-            state.scaler_states[loss_id], grads
-        )
+        """``finite_reduce`` (e.g.
+        :func:`apex_tpu.transformer.amp.model_parallel_all_finite`)
+        reduces the per-rank finite flag to a cross-rank consensus
+        *before* the scale adjustment — the reference's model-parallel
+        GradScaler found_inf all-reduce (grad_scaler.py:25-36).  Without
+        it, sharded grads make the flag vary across model-parallel
+        ranks."""
+        sstate = state.scaler_states[loss_id]
+        grads, finite = self.scaler.unscale(sstate, grads)
+        if finite is not None and finite_reduce is not None:
+            finite = finite_reduce(finite)
+        new_sstate = self.scaler.adjust(sstate, finite)
         states = list(state.scaler_states)
         states[loss_id] = new_sstate
         return grads, finite, AmpState(scaler_states=tuple(states))

@@ -41,9 +41,15 @@ def set_low_precision_dtype(dtype) -> None:
 
 
 def _cast_tree(args, dtype):
+    import numpy as np
+
     def cast(x):
-        if isinstance(x, jnp.ndarray) and jnp.issubdtype(x.dtype, jnp.floating):
-            return x.astype(dtype)
+        # jax arrays AND numpy arrays (every jnp function accepts both;
+        # the reference's torch wrappers likewise cast any tensor input)
+        if isinstance(x, (jnp.ndarray, np.ndarray)) and jnp.issubdtype(
+            x.dtype, jnp.floating
+        ):
+            return jnp.asarray(x, dtype)
         return x
 
     return jax.tree.map(cast, args)

@@ -1,0 +1,179 @@
+"""Curated cast lists — the numerics knowledge of the reference's amp O1.
+
+The reference classifies every torch op as fp16/bf16-safe (convs + BLAS),
+fp32-required (softmax / norms / losses / pow / reductions), or
+dtype-promoting (reference: apex/amp/lists/torch_overrides.py:7-47 for the
+white/blacklists, functional_overrides.py:18-40, tensor_overrides.py), and
+patches the namespaces accordingly.  This module ships the same
+classification over ``jax.numpy`` / ``jax.nn`` / ``jax.lax`` callables and
+applies it through the decorators in :mod:`apex_tpu.amp.functional`.
+
+Two application modes:
+
+- :func:`cast_namespaces` — the JAX-idiomatic form: returns *proxy*
+  namespaces (``.numpy``, ``.nn``, ``.lax``) whose listed functions are
+  wrapped; everything else passes through.  No global state is touched::
+
+      amp_ns = cast_namespaces()
+      y = amp_ns.numpy.matmul(a, b)      # runs in the low-precision dtype
+      p = amp_ns.nn.softmax(logits)      # always fp32 internally
+
+- :func:`patch` — the reference-parity form: mutates the real modules in
+  place via the ``register_*`` machinery (what apex O1 does to torch) and
+  returns a handle whose ``restore()`` undoes it.  Use sparingly; the
+  proxy form composes better with jit.
+
+Promote lists: the reference needs explicit promote wrappers because
+torch errors on mixed-dtype operands.  ``jax.numpy`` already applies
+type promotion to every listed op, so ``PROMOTE_NUMPY`` /
+``SEQUENCE_NUMPY`` are documentation plus optional belt-and-suspenders
+wrapping — behavior is identical either way.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.functional import (
+    float_function,
+    half_function,
+    promote_function,
+)
+
+__all__ = [
+    "LOW_PRECISION_NUMPY",
+    "LOW_PRECISION_LAX",
+    "FP32_NUMPY",
+    "FP32_NN",
+    "PROMOTE_NUMPY",
+    "SEQUENCE_NUMPY",
+    "cast_namespaces",
+    "patch",
+]
+
+# ---------------------------------------------------------------------------
+# The lists.  Mapping from the reference's torch names to JAX callables:
+# fp16/bf16-safe = the MXU ops (BLAS + convolutions), exactly the
+# reference's whitelist class (torch_overrides.py:7-25 — conv*, mm, bmm,
+# matmul, addmm, ...).
+# ---------------------------------------------------------------------------
+
+LOW_PRECISION_NUMPY: List[str] = [
+    "matmul", "dot", "vdot", "inner", "outer", "tensordot", "einsum",
+]
+
+LOW_PRECISION_LAX: List[str] = [
+    "dot", "dot_general", "conv", "conv_general_dilated",
+    "conv_transpose", "conv_with_general_padding",
+]
+
+# fp32-required = numerically sensitive transcendentals, reductions and
+# normalizations (torch_overrides.py:27-47 — acos..log*, pow, softmax,
+# norms, cumsum/prod, sums; functional_overrides.py:18-40 — softmax,
+# layer_norm, losses).
+FP32_NUMPY: List[str] = [
+    "arccos", "arcsin", "arctan", "cosh", "sinh", "tan",
+    "exp", "expm1", "log", "log10", "log1p", "log2",
+    "power", "float_power", "reciprocal",
+    "sum", "prod", "cumsum", "cumprod", "mean", "std", "var",
+]
+
+FP32_NN: List[str] = [
+    "softmax", "log_softmax", "logsumexp", "standardize",
+]
+
+# multi-operand ops the reference must explicitly promote
+# (tensor_overrides.py CASTS / SEQUENCE_CASTS); jnp promotes natively.
+PROMOTE_NUMPY: List[str] = [
+    "add", "subtract", "multiply", "divide", "true_divide",
+    "arctan2", "cross", "hypot", "maximum", "minimum",
+]
+
+SEQUENCE_NUMPY: List[str] = ["concatenate", "stack", "hstack", "vstack"]
+
+
+_PLAN: List[Tuple[Any, List[str], Callable]] = [
+    (jnp, LOW_PRECISION_NUMPY, half_function),
+    (lax, LOW_PRECISION_LAX, half_function),
+    (jnp, FP32_NUMPY, float_function),
+    (jax.nn, FP32_NN, float_function),
+    (jnp, PROMOTE_NUMPY, promote_function),
+    (jnp, SEQUENCE_NUMPY, promote_function),
+]
+
+
+class _CastNamespace:
+    """Attribute proxy: listed names are wrapped, the rest pass through."""
+
+    def __init__(self, module: Any, overrides: Dict[str, Callable]):
+        self._module = module
+        self._overrides = overrides
+
+    def __getattr__(self, name: str):
+        try:
+            return self._overrides[name]
+        except KeyError:
+            return getattr(self._module, name)
+
+
+def _overrides_for(module: Any) -> Dict[str, Callable]:
+    out: Dict[str, Callable] = {}
+    for mod, names, deco in _PLAN:
+        if mod is not module:
+            continue
+        for name in names:
+            fn = getattr(module, name, None)
+            if fn is not None:
+                out[name] = deco(fn)
+    return out
+
+
+def cast_namespaces() -> SimpleNamespace:
+    """Proxy namespaces with the cast lists applied (no global mutation).
+
+    ``half``-class wrappers follow the process low-precision dtype, so
+    :func:`apex_tpu.amp.set_low_precision_dtype` flips them between fp16
+    (O1) and bf16 (O4).
+    """
+    return SimpleNamespace(
+        numpy=_CastNamespace(jnp, _overrides_for(jnp)),
+        nn=_CastNamespace(jax.nn, _overrides_for(jax.nn)),
+        lax=_CastNamespace(lax, _overrides_for(lax)),
+    )
+
+
+class _PatchHandle:
+    def __init__(self, saved: List[Tuple[Any, str, Callable]]):
+        self._saved = saved
+
+    def restore(self) -> None:
+        for mod, name, fn in self._saved:
+            setattr(mod, name, fn)
+        self._saved = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()
+        return False
+
+
+def patch() -> _PatchHandle:
+    """Apply the cast lists to the *real* jnp / jax.nn / lax modules
+    (the reference's O1 monkey-patch, apex/amp/amp.py:75-198) and return
+    a context-manager handle that restores the originals."""
+    saved: List[Tuple[Any, str, Callable]] = []
+    for mod, names, deco in _PLAN:
+        for name in names:
+            fn = getattr(mod, name, None)
+            if fn is None:
+                continue
+            saved.append((mod, name, fn))
+            setattr(mod, name, deco(fn))
+    return _PatchHandle(saved)

@@ -73,7 +73,14 @@ class _MHABase:
         include_norm_add: bool = False,
         impl: str = "fast",
         params_dtype: Any = jnp.float32,
+        policy: Any = None,
     ):
+        norm_dtype = params_dtype
+        if policy is not None:  # amp.Policy drives the param dtypes
+            params_dtype = policy.param_dtype
+            norm_dtype = (
+                jnp.float32 if policy.keep_norm_fp32 else policy.param_dtype
+            )
         if embed_dim % num_heads:
             raise ValueError("embed_dim must be divisible by num_heads")
         if impl not in ("fast", "default"):
@@ -87,11 +94,12 @@ class _MHABase:
         self.include_norm_add = include_norm_add
         self.impl = impl
         self.params_dtype = params_dtype
+        self.norm_dtype = norm_dtype
 
     def _ln_params(self):
         return {
-            "scale": jnp.ones((self.embed_dim,), self.params_dtype),
-            "bias": jnp.zeros((self.embed_dim,), self.params_dtype),
+            "scale": jnp.ones((self.embed_dim,), self.norm_dtype),
+            "bias": jnp.zeros((self.embed_dim,), self.norm_dtype),
         }
 
     def _maybe_norm(self, params, x):

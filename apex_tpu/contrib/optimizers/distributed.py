@@ -89,11 +89,36 @@ class _FlatMeta:
 
 
 class _DistributedOptimizer:
-    """Shared reduce-scatter → sharded step → all-gather skeleton."""
+    """Shared reduce-scatter → sharded step → all-gather skeleton.
 
-    def __init__(self, lr: float, axis_name: str = DATA_PARALLEL_AXIS):
+    ``axis_name`` may be a single mesh axis ("dp") or a **nested pair**
+    ``(dcn_axis, ici_axis)`` for the reference's two-level hierarchy
+    (reference: distributed_fused_adam.py:106-160, intra-group
+    reduce-scatter + inter-group all-reduce with dwu_group_size): grads
+    reduce-scatter *within* the fast ici axis, the resulting 1/ici
+    shards all-reduce *across* the slow dcn axis (each DCN message is
+    1/ici of the gradient), the sharded step runs per ici rank with
+    state replicated across dcn groups, and the all-gather rides ici
+    only — no parameter bytes ever cross DCN.
+    """
+
+    def __init__(self, lr: float, axis_name: Any = DATA_PARALLEL_AXIS):
         self.lr = lr
         self.axis_name = axis_name
+
+    @property
+    def _hierarchical(self) -> bool:
+        return isinstance(self.axis_name, (tuple, list))
+
+    @property
+    def _shard_axis(self) -> str:
+        """Axis the state shards over (ici for hierarchical)."""
+        return self.axis_name[1] if self._hierarchical else self.axis_name
+
+    @property
+    def _cross_axis(self) -> Optional[str]:
+        """Axis the reduced shards all-reduce across (dcn), if any."""
+        return self.axis_name[0] if self._hierarchical else None
 
     # subclass hook: update on the local 1-D fp32 shard
     def _update_shard(
@@ -108,16 +133,18 @@ class _DistributedOptimizer:
         }
 
     def state_specs(self) -> dict:
-        specs = {k: P(self.axis_name) for k in self._extra_init(1)}
+        ax = self._shard_axis
+        specs = {k: P(ax) for k in self._extra_init(1)}
         specs["step"] = P()
-        specs["master"] = P(self.axis_name)
+        specs["master"] = P(ax)
         return specs
 
     def init(self, params: Any) -> dict:
         """Build the sharded state — call inside shard_map with
-        replicated params; each rank keeps only its flat shard."""
-        world = lax.axis_size(self.axis_name)
-        rank = lax.axis_index(self.axis_name)
+        replicated params; each rank keeps only its flat shard
+        (1/ici per device, replicated across dcn, when hierarchical)."""
+        world = lax.axis_size(self._shard_axis)
+        rank = lax.axis_index(self._shard_axis)
         meta = _FlatMeta(params, world)
         flat = meta.flatten(params)
         local = lax.dynamic_slice(flat, (rank * meta.shard,), (meta.shard,))
@@ -140,17 +167,23 @@ class _DistributedOptimizer:
         (reference: distributed_fused_adam.py overlapped RS+AR).
         Returns (new_params in model dtype, new_state).
         """
-        world = lax.axis_size(self.axis_name)
-        rank = lax.axis_index(self.axis_name)
+        world = lax.axis_size(self._shard_axis)
+        rank = lax.axis_index(self._shard_axis)
         meta = _FlatMeta(params, world)
         lr = f32(self.lr if lr is None else lr)
 
         flat_grads = meta.flatten(grads)
         # mean-reduce-scatter: each rank receives its shard of the
-        # dp-summed gradient
-        g_local = (
-            lax.psum_scatter(flat_grads, self.axis_name, tiled=True) / world
+        # dp-summed gradient.  Hierarchical: RS within ici, then AR of
+        # the 1/ici shard across dcn (reference's 2-level pattern)
+        g_local = lax.psum_scatter(
+            flat_grads, self._shard_axis, tiled=True
         )
+        total = world
+        if self._cross_axis is not None:
+            g_local = lax.psum(g_local, self._cross_axis)
+            total = world * lax.axis_size(self._cross_axis)
+        g_local = g_local / total
         ids = meta.segment_ids()
         ids_local = lax.dynamic_slice(
             ids, (rank * meta.shard,), (meta.shard,)
@@ -172,7 +205,7 @@ class _DistributedOptimizer:
             new_master = new_state["master"]
 
         flat_params = all_gather_invariant(
-            new_master, self.axis_name, axis=0, tiled=True
+            new_master, self._shard_axis, axis=0, tiled=True
         )
         new_params = meta.unflatten(flat_params)
         return new_params, new_state
@@ -252,7 +285,9 @@ class DistributedFusedLAMB(_DistributedOptimizer):
         partial = jax.ops.segment_sum(
             jnp.square(x), ids_local, num_segments=meta.num_leaves + 1
         )
-        return jnp.sqrt(lax.psum(partial, self.axis_name))
+        # shards are over the shard axis only (replicated across
+        # dcn when hierarchical), so one psum reassembles the norm
+        return jnp.sqrt(lax.psum(partial, self._shard_axis))
 
     def _update_shard(self, extra, step, g, p, lr, meta, ids_local):
         b1, b2 = f32(self.beta1), f32(self.beta2)
@@ -268,7 +303,7 @@ class DistributedFusedLAMB(_DistributedOptimizer):
         # global grad-norm clip (clip-after-reduce, the reference's
         # `_clip_after_ar` default path)
         gnorm = jnp.sqrt(
-            lax.psum(jnp.sum(jnp.square(g)), self.axis_name)
+            lax.psum(jnp.sum(jnp.square(g)), self._shard_axis)
         )
         if self.max_grad_norm is not None and self.max_grad_norm > 0:
             clip = jnp.where(

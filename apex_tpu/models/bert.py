@@ -46,11 +46,16 @@ class BertConfig:
     init_method_std: float = 0.02
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
+    # an amp.Policy drives both dtypes (one-kwarg O0..O5 switch)
+    policy: Optional[Any] = None
     remat: bool = True
     add_binary_head: bool = True
     attention_impl: Optional[str] = None  # "pallas" | "xla" | None=auto
 
     def __post_init__(self):
+        if self.policy is not None:
+            self.params_dtype = self.policy.param_dtype
+            self.compute_dtype = self.policy.compute_dtype
         if self.ffn_hidden_size is None:
             self.ffn_hidden_size = 4 * self.hidden_size
         if self.hidden_size % self.num_attention_heads:
@@ -61,6 +66,12 @@ class BertConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def norm_dtype(self):
+        if self.policy is not None and self.policy.keep_norm_fp32:
+            return jnp.float32
+        return self.params_dtype
 
 
 def _normal(std):
@@ -109,8 +120,8 @@ class BertModel:
     def _ln(self):
         c = self.config
         return {
-            "scale": jnp.ones((c.hidden_size,), c.params_dtype),
-            "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
+            "scale": jnp.ones((c.hidden_size,), c.norm_dtype),
+            "bias": jnp.zeros((c.hidden_size,), c.norm_dtype),
         }
 
     def _init_one_layer(self, key) -> Dict[str, Any]:

@@ -73,6 +73,12 @@ class GPTConfig:
     init_method_std: float = 0.02
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
+    # an amp.Policy drives the dtypes (and, via policy.master_weights /
+    # policy.loss_scale, the train-loop wiring) — the initialize-and-
+    # forget UX of the reference's amp.initialize
+    # (apex/amp/_initialize.py:145-265): one kwarg switches the model
+    # across O0..O5
+    policy: Optional[Any] = None
     remat: bool = True
     remat_policy: Optional[str] = "dots_saveable"
     attention_impl: Optional[str] = None  # None → pick by platform
@@ -89,6 +95,9 @@ class GPTConfig:
     moe_aux_weight: float = 0.01
 
     def __post_init__(self):
+        if self.policy is not None:
+            self.params_dtype = self.policy.param_dtype
+            self.compute_dtype = self.policy.compute_dtype
         if self.ffn_hidden_size is None:
             self.ffn_hidden_size = 4 * self.hidden_size
         if self.hidden_size % self.num_attention_heads:
@@ -104,6 +113,15 @@ class GPTConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def norm_dtype(self) -> Any:
+        """LayerNorm parameter dtype: fp32 under a keep-norm-fp32 policy
+        (the reference's keep_batchnorm_fp32 / convert_network contract,
+        apex/fp16_utils/fp16util.py:60)."""
+        if self.policy is not None and self.policy.keep_norm_fp32:
+            return jnp.float32
+        return self.params_dtype
 
 
 def _normal(std):
@@ -185,8 +203,8 @@ class GPTModel:
         keys = jax.random.split(key, 4)
         c = self.config
         ln = lambda: {
-            "scale": jnp.ones((c.hidden_size,), c.params_dtype),
-            "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
+            "scale": jnp.ones((c.hidden_size,), c.norm_dtype),
+            "bias": jnp.zeros((c.hidden_size,), c.norm_dtype),
         }
         layer = {
             "ln1": ln(),
@@ -214,8 +232,8 @@ class GPTModel:
             ),
             "layers": layers,
             "final_ln": {
-                "scale": jnp.ones((c.hidden_size,), c.params_dtype),
-                "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
+                "scale": jnp.ones((c.hidden_size,), c.norm_dtype),
+                "bias": jnp.zeros((c.hidden_size,), c.norm_dtype),
             },
         }
 

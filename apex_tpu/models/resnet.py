@@ -40,15 +40,27 @@ class ResNetConfig:
     width: int = 64
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
+    # an amp.Policy overrides the two dtypes above and keeps BN params
+    # fp32 when it says so (the reference's keep_batchnorm_fp32)
+    policy: Optional[Any] = None
     bn_momentum: float = 0.1
     bn_eps: float = 1e-5
     # None → local-batch BN; "dp" → SyncBN over the data-parallel axis
     sync_bn_axis: Optional[str] = DATA_PARALLEL_AXIS
 
     def __post_init__(self):
+        if self.policy is not None:
+            self.params_dtype = self.policy.param_dtype
+            self.compute_dtype = self.policy.compute_dtype
         if self.depth not in _DEPTHS:
             raise ValueError(f"unsupported depth {self.depth}")
         self.stage_blocks, self.bottleneck = _DEPTHS[self.depth]
+
+    @property
+    def norm_dtype(self):
+        if self.policy is not None and self.policy.keep_norm_fp32:
+            return jnp.float32
+        return self.params_dtype
 
 
 _he = he_init
@@ -67,9 +79,9 @@ class ResNet:
         return (
             {
                 "scale": jnp.full(
-                    (c,), 0.0 if zero_scale else 1.0, self.config.params_dtype
+                    (c,), 0.0 if zero_scale else 1.0, self.config.norm_dtype
                 ),
-                "bias": jnp.zeros((c,), self.config.params_dtype),
+                "bias": jnp.zeros((c,), self.config.norm_dtype),
             },
             {
                 "mean": jnp.zeros((c,), jnp.float32),

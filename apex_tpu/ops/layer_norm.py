@@ -27,7 +27,14 @@ import jax.numpy as jnp
 
 from apex_tpu.ops.common import run_kernel, shape_struct
 
-from apex_tpu.utils.platform import default_implementation, is_tpu
+from apex_tpu.utils.platform import is_tpu
+
+try:  # imported lazily on CPU-only hosts that lack Mosaic
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
 
 __all__ = [
     "fused_layer_norm",
@@ -65,18 +72,20 @@ def _ln_fwd_kernel(x_ref, o_ref, mean_ref, invvar_ref, *, eps, rms):
     var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
     invvar = jax.lax.rsqrt(var + eps)
     o_ref[:] = ((x - mean) * invvar).astype(o_ref.dtype)
-    # stats are written (1, rows)-shaped: Mosaic requires lane-tiled 2-D
-    # layouts; 1-D f32 outputs mis-tile against XLA ({T(256)} vs {T(1024)})
-    mean_ref[0, :] = mean[:, 0]
-    invvar_ref[0, :] = invvar[:, 0]
+    # stats are written as (grid, 1, block_rows) — the singleton keeps
+    # the trailing block dims equal to the array dims, which frees
+    # block_rows from the 128-lane tiling/alignment rules so large
+    # hidden sizes can use small row blocks without blowing VMEM
+    mean_ref[0, 0, :] = mean[:, 0]
+    invvar_ref[0, 0, :] = invvar[:, 0]
 
 
 def _ln_fwd_pallas(x2d: jnp.ndarray, eps: float, rms: bool):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
     rows, hidden = x2d.shape
-    block_rows = max(8, min(256, rows))
+    # block sized so in+out+fp32 intermediates stay well under the 16 MB
+    # VMEM scope: ~2 MB of fp32 per block buffer
+    cap = max(8, (512 * 1024) // max(hidden, 1) // 8 * 8)
+    block_rows = max(8, min(cap, min(256, rows)))
     # pad rows to a multiple of block_rows
     pad = (-rows) % block_rows
     if pad:
@@ -93,18 +102,19 @@ def _ln_fwd_pallas(x2d: jnp.ndarray, eps: float, rms: bool):
         out_specs=[
             pl.BlockSpec((block_rows, hidden), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
-            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, 1, block_rows), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, block_rows), lambda i: (i, 0, 0)),
         ],
         out_shape=[
             shape_struct((padded_rows, hidden), x2d.dtype, x2d),
-            shape_struct((1, padded_rows), jnp.float32, x2d),
-            shape_struct((1, padded_rows), jnp.float32, x2d),
+            shape_struct((grid[0], 1, block_rows), jnp.float32, x2d),
+            shape_struct((grid[0], 1, block_rows), jnp.float32, x2d),
         ],
         # interpreter mode off-TPU so the kernel body stays testable
         interpret=not is_tpu(),
     )(x2d)
-    mean, invvar = mean[0], invvar[0]
+    mean = mean.reshape(padded_rows)
+    invvar = invvar.reshape(padded_rows)
     if pad:
         out, mean, invvar = out[:rows], mean[:rows], invvar[:rows]
     return out, mean, invvar
@@ -124,12 +134,17 @@ def _ln_fwd_xla(x2d: jnp.ndarray, eps: float, rms: bool):
 
 
 def _ln_fwd(x2d, eps, rms, implementation: Optional[str]):
+    # Auto mode routes to XLA *by measurement*: layernorm is bandwidth-
+    # bound and XLA's fused mean/var/normalize pipeline beats the Pallas
+    # tile kernel on every swept shape (0.7-1.0x, KERNELS_TPU.json).
+    # The kernel stays available via implementation='pallas' for the
+    # cross-check tier.
     return run_kernel(
         "fused_layer_norm",
         lambda: _ln_fwd_pallas(x2d, eps, rms),
         lambda: _ln_fwd_xla(x2d, eps, rms),
         implementation,
-        implementation or default_implementation(),
+        implementation or "xla",
     )
 
 

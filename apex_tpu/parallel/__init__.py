@@ -17,10 +17,15 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     DistributedDataParallel,
     all_reduce_gradients,
     data_parallel_mesh,
+    hierarchical_data_parallel_mesh,
 )
 from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
     SyncBatchNorm,
     sync_batch_norm,
+)
+from apex_tpu.parallel.convert import (  # noqa: F401
+    convert_syncbn_model,
+    convert_syncbn_variables,
 )
 from apex_tpu.optimizers.larc import LARC  # noqa: F401
 
@@ -28,7 +33,10 @@ __all__ = [
     "DistributedDataParallel",
     "all_reduce_gradients",
     "data_parallel_mesh",
+    "hierarchical_data_parallel_mesh",
     "SyncBatchNorm",
     "sync_batch_norm",
+    "convert_syncbn_model",
+    "convert_syncbn_variables",
     "LARC",
 ]

@@ -1,0 +1,142 @@
+"""Recursive BatchNorm → SyncBatchNorm conversion.
+
+Capability match of the reference's ``convert_syncbn_model``
+(reference: apex/parallel/__init__.py:21-95): walk a model tree and swap
+every BatchNorm for the cross-replica SyncBatchNorm, preserving
+hyperparameters.  Two flax-specific notes:
+
+- flax modules are immutable dataclasses composed declaratively, so the
+  walk rebuilds parents with ``Module.clone``; children created inside
+  ``setup()``/``__call__`` bodies are code, not data, and cannot be
+  rewritten (use :class:`~apex_tpu.parallel.SyncBatchNorm` directly
+  there).
+- parameters/stats live outside the module, so the state copy the
+  reference does in-place (``mod.running_mean = child.running_mean``)
+  becomes :func:`convert_syncbn_variables` over the variables pytree
+  (``scale``→``weight``, ``mean``→``running_mean``, ``var``→``running_var``).
+
+``process_group_size`` maps to the reference's
+``create_syncbn_process_group`` group-limited stats reduction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+from apex_tpu.transformer.parallel_state import DATA_PARALLEL_AXIS
+
+__all__ = ["convert_syncbn_model", "convert_syncbn_variables"]
+
+
+def _convert_bn(bn: nn.BatchNorm, axis_name: str,
+                process_group_size: int) -> SyncBatchNorm:
+    if bool(bn.use_scale) != bool(bn.use_bias):
+        # SyncBatchNorm has a single affine switch; converting a
+        # scale-only/bias-only BN would silently orphan the learned
+        # parameter — refuse instead
+        raise ValueError(
+            "convert_syncbn_model cannot convert a BatchNorm with "
+            f"use_scale={bn.use_scale}, use_bias={bn.use_bias}: "
+            "SyncBatchNorm supports affine with both or neither"
+        )
+    # flax momentum is the *decay* of the running average; the torch/apex
+    # convention (which SyncBatchNorm follows) is the update weight
+    return SyncBatchNorm(
+        num_features=None,  # inferred from the input at call
+        eps=float(bn.epsilon),
+        momentum=1.0 - float(bn.momentum),
+        affine=bool(bn.use_scale and bn.use_bias),
+        axis_name=axis_name,
+        process_group_size=process_group_size,
+        param_dtype=bn.param_dtype or jnp.float32,
+    )
+
+
+def _convert_value(v: Any, axis_name: str, group: int) -> Any:
+    if isinstance(v, nn.BatchNorm):
+        return _convert_bn(v, axis_name, group)
+    if isinstance(v, nn.Module):
+        return convert_syncbn_model(v, axis_name=axis_name,
+                                    process_group_size=group)
+    if isinstance(v, (list, tuple)):
+        out = type(v)(_convert_value(x, axis_name, group) for x in v)
+        return out
+    if isinstance(v, dict):
+        return {k: _convert_value(x, axis_name, group)
+                for k, x in v.items()}
+    return v
+
+
+def convert_syncbn_model(
+    module: nn.Module,
+    axis_name: str = DATA_PARALLEL_AXIS,
+    process_group_size: int = 0,
+) -> nn.Module:
+    """Recursively replace every ``nn.BatchNorm`` in a declaratively
+    composed module tree with :class:`SyncBatchNorm`
+    (reference: apex/parallel/__init__.py:21-95)."""
+    if isinstance(module, nn.BatchNorm):
+        return _convert_bn(module, axis_name, process_group_size)
+    updates = {}
+    for f in dataclasses.fields(module):
+        if f.name in ("name", "parent"):
+            continue
+        old = getattr(module, f.name)
+        new = _convert_value(old, axis_name, process_group_size)
+        if new is not old:
+            updates[f.name] = new
+    return module.clone(**updates) if updates else module
+
+
+def _bn_paths(stats_tree: Any, prefix: tuple = ()) -> set:
+    """Module paths whose batch_stats hold BN's (mean, var) leaves —
+    the only reliable BN marker in a variables pytree (LayerNorm etc.
+    also use a 'scale' param but keep no running stats)."""
+    out = set()
+    if isinstance(stats_tree, dict):
+        leaves = {
+            k for k, v in stats_tree.items() if not isinstance(v, dict)
+        }
+        if {"mean", "var"} <= leaves:
+            out.add(prefix)
+        for k, v in stats_tree.items():
+            out |= _bn_paths(v, prefix + (k,))
+    return out
+
+
+def _rename_at(tree: Any, paths: set, renames: dict,
+               prefix: tuple = ()) -> Any:
+    if not isinstance(tree, dict):
+        return tree
+    out = {}
+    for k, v in tree.items():
+        nk = renames.get(k, k) if prefix in paths else k
+        out[nk] = _rename_at(v, paths, renames, prefix + (k,))
+    return out
+
+
+def convert_syncbn_variables(variables: Any) -> Any:
+    """Rename a converted model's BatchNorm state to SyncBatchNorm's
+    names so pre-trained variables keep working: params ``scale`` →
+    ``weight``; batch_stats ``mean``/``var`` →
+    ``running_mean``/``running_var`` (the reference copies these fields
+    module-by-module; here the state is a pytree).  Only modules whose
+    batch_stats carry (mean, var) are touched, so LayerNorm/GroupNorm
+    'scale' params survive untouched."""
+    variables = dict(variables)
+    paths = _bn_paths(variables.get("batch_stats", {}))
+    if "params" in variables:
+        variables["params"] = _rename_at(
+            variables["params"], paths, {"scale": "weight"}
+        )
+    if "batch_stats" in variables:
+        variables["batch_stats"] = _rename_at(
+            variables["batch_stats"], paths,
+            {"mean": "running_mean", "var": "running_var"},
+        )
+    return variables

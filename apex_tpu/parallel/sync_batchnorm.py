@@ -124,7 +124,8 @@ class SyncBatchNorm(nn.Module):
     ``use_running_average=True`` (or ``deterministic``) for eval.
     """
 
-    num_features: int
+    # None → inferred from the input's trailing (channel) dim at call
+    num_features: Optional[int] = None
     eps: float = 1e-5
     momentum: float = 0.1
     affine: bool = True
@@ -136,23 +137,27 @@ class SyncBatchNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x, use_running_average: bool = False):
+        num_features = (
+            self.num_features if self.num_features is not None
+            else x.shape[-1]
+        )
         weight = bias = None
         if self.affine:
             weight = self.param(
-                "weight", nn.initializers.ones, (self.num_features,),
+                "weight", nn.initializers.ones, (num_features,),
                 self.param_dtype,
             )
             bias = self.param(
-                "bias", nn.initializers.zeros, (self.num_features,),
+                "bias", nn.initializers.zeros, (num_features,),
                 self.param_dtype,
             )
         ra_mean = self.variable(
             "batch_stats", "running_mean",
-            lambda: jnp.zeros((self.num_features,), jnp.float32),
+            lambda: jnp.zeros((num_features,), jnp.float32),
         )
         ra_var = self.variable(
             "batch_stats", "running_var",
-            lambda: jnp.ones((self.num_features,), jnp.float32),
+            lambda: jnp.ones((num_features,), jnp.float32),
         )
         training = not use_running_average
         out, new_rm, new_rv = sync_batch_norm(

@@ -26,10 +26,14 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 
+from apex_tpu.pyprof.parse import op_table, parse  # noqa: E402,F401
+
 __all__ = [
     "annotate",
     "trace_region",
     "trace",
+    "parse",
+    "op_table",
     "cost_analysis",
     "summarize",
     "Timers",

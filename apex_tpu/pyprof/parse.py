@@ -1,0 +1,182 @@
+"""xplane trace → per-op table (the reference's ``pyprof.parse`` stage).
+
+The reference parses nvprof's SQLite database into per-kernel records
+(reference: apex/pyprof/parse/parse.py + db.py/kernel.py/nvvp.py) that its
+``prof`` stage turns into per-op tables.  The TPU equivalent consumes the
+xplane protobuf the JAX profiler writes (``<log_dir>/plugins/profile/...
+*.xplane.pb``) and aggregates device events into (name, count, total ms,
+%) rows — no tensorflow dependency: the few XSpace fields needed are read
+with a minimal protobuf wire-format reader.
+
+Field numbers (tsl/profiler/protobuf/xplane.proto, verified against
+traces this code ships tests for):
+  XSpace.planes = 1
+  XPlane.name = 2, .lines = 3, .event_metadata = 4 (map<id, XEventMetadata>)
+  XLine.name = 2, .events = 4
+  XEvent.metadata_id = 1, .duration_ps = 3
+  XEventMetadata.id = 1, .name = 2
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["parse", "op_table"]
+
+
+# ---------------------------------------------------------------------------
+# minimal protobuf wire reader
+# ---------------------------------------------------------------------------
+
+
+def _varint(b: bytes, i: int) -> Tuple[int, int]:
+    r = 0
+    s = 0
+    while True:
+        x = b[i]
+        i += 1
+        r |= (x & 0x7F) << s
+        if not x & 0x80:
+            return r, i
+        s += 7
+
+
+def _fields(b: bytes) -> Iterable[Tuple[int, int, Any]]:
+    i = 0
+    n = len(b)
+    while i < n:
+        tag, i = _varint(b, i)
+        f, w = tag >> 3, tag & 7
+        if w == 0:
+            v, i = _varint(b, i)
+        elif w == 2:
+            ln, i = _varint(b, i)
+            v = b[i : i + ln]
+            i += ln
+        elif w == 1:
+            v = b[i : i + 8]
+            i += 8
+        elif w == 5:
+            v = b[i : i + 4]
+            i += 4
+        else:  # unknown wire type: cannot continue safely
+            return
+        yield f, w, v
+
+
+def _first(msg: bytes, field: int, default=None):
+    for f, _, v in _fields(msg):
+        if f == field:
+            return v
+    return default
+
+
+# ---------------------------------------------------------------------------
+# xplane walk
+# ---------------------------------------------------------------------------
+
+
+def _iter_planes(space: bytes):
+    for f, w, v in _fields(space):
+        if f == 1 and w == 2:
+            yield v
+
+
+def _event_metadata(plane: bytes) -> Dict[int, str]:
+    meta: Dict[int, str] = {}
+    for f, w, v in _fields(plane):
+        if f == 4 and w == 2:  # map entry {key=1, value=XEventMetadata}
+            key = _first(v, 1, 0)
+            em = _first(v, 2, b"")
+            name = _first(em, 2, b"")
+            if isinstance(name, bytes):
+                meta[key] = name.decode("utf-8", "replace")
+    return meta
+
+
+def parse(
+    log_dir: str,
+    plane_filter: Optional[str] = None,
+    line_filter: Optional[str] = None,
+    exclude_prefixes: Tuple[str, ...] = ("end: ", "$"),
+) -> List[Dict[str, Any]]:
+    """Aggregate a captured trace into per-op rows.
+
+    ``log_dir`` is the directory given to :func:`apex_tpu.pyprof.trace`.
+    Optional ``plane_filter`` / ``line_filter`` are case-insensitive
+    substring matches (e.g. ``plane_filter="TPU"``); by default every
+    plane/line is read.  Events whose names start with one of
+    ``exclude_prefixes`` are skipped (python-frame markers and paired
+    ``end:`` markers, which would double-count).
+
+    Returns rows sorted by total time, each::
+
+        {"name", "count", "total_ms", "avg_ms", "pct", "plane", "line"}
+
+    ``pct`` is relative to the summed duration of the *included* events.
+    """
+    paths = sorted(glob.glob(
+        os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True
+    ))
+    if not paths:
+        raise FileNotFoundError(
+            f"no *.xplane.pb under {log_dir!r} — did the trace() context "
+            "complete?"
+        )
+    agg: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+    for path in paths:
+        with open(path, "rb") as fh:
+            space = fh.read()
+        for plane in _iter_planes(space):
+            pname_b = _first(plane, 2, b"")
+            pname = pname_b.decode("utf-8", "replace")
+            if plane_filter and plane_filter.lower() not in pname.lower():
+                continue
+            meta = _event_metadata(plane)
+            for f, w, line in _fields(plane):
+                if f != 3 or w != 2:
+                    continue
+                lname = _first(line, 2, b"")
+                lname = (
+                    lname.decode("utf-8", "replace")
+                    if isinstance(lname, bytes) else str(lname)
+                )
+                if line_filter and line_filter.lower() not in lname.lower():
+                    continue
+                for ef, ew, ev in _fields(line):
+                    if ef != 4 or ew != 2:
+                        continue
+                    mid = _first(ev, 1, 0)
+                    dur = _first(ev, 3, 0)
+                    name = meta.get(mid, f"<metadata {mid}>")
+                    if any(name.startswith(p) for p in exclude_prefixes):
+                        continue
+                    key = (pname, lname, name)
+                    row = agg.setdefault(key, {
+                        "name": name, "plane": pname, "line": lname,
+                        "count": 0, "total_ms": 0.0,
+                    })
+                    row["count"] += 1
+                    row["total_ms"] += (dur or 0) / 1e9  # ps → ms
+    rows = sorted(agg.values(), key=lambda r: -r["total_ms"])
+    total = sum(r["total_ms"] for r in rows) or 1.0
+    for r in rows:
+        r["avg_ms"] = r["total_ms"] / max(r["count"], 1)
+        r["pct"] = 100.0 * r["total_ms"] / total
+    return rows
+
+
+def op_table(rows: List[Dict[str, Any]], top: int = 25) -> str:
+    """Format parse() rows the way the reference's ``prof`` stage prints
+    its per-op table."""
+    lines = [
+        f"{'op':<48} {'count':>6} {'total ms':>10} {'avg ms':>9} {'%':>6}"
+    ]
+    for r in rows[:top]:
+        lines.append(
+            f"{r['name'][:48]:<48} {r['count']:>6} "
+            f"{r['total_ms']:>10.3f} {r['avg_ms']:>9.3f} {r['pct']:>6.1f}"
+        )
+    return "\n".join(lines)

@@ -53,6 +53,10 @@ __all__ = [
     "get_context_parallel_rank",
     "is_pipeline_first_stage",
     "is_pipeline_last_stage",
+    "is_pipeline_stage_before_split",
+    "is_pipeline_stage_after_split",
+    "is_pipeline_stage_at_split",
+    "get_pipeline_model_parallel_split_rank",
     "get_pipeline_model_parallel_next_rank",
     "get_pipeline_model_parallel_prev_rank",
     "get_virtual_pipeline_model_parallel_world_size",
@@ -69,6 +73,9 @@ TENSOR_PARALLEL_AXIS = "tp"
 _MESH: Optional[Mesh] = None
 _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
 _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+# encoder/decoder boundary for ModelType.encoder_and_decoder pipelines
+# (reference: pipeline_model_parallel_split_rank)
+_PIPELINE_MODEL_PARALLEL_SPLIT_RANK: Optional[int] = None
 
 
 def initialize_model_parallel(
@@ -76,6 +83,7 @@ def initialize_model_parallel(
     pipeline_model_parallel_size_: int = 1,
     virtual_pipeline_model_parallel_size_: Optional[int] = None,
     context_parallel_size_: int = 1,
+    pipeline_model_parallel_split_rank_: Optional[int] = None,
     *,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
@@ -88,6 +96,7 @@ def initialize_model_parallel(
     """
     global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
 
     if _MESH is not None:
         # the reference raises on double-init too; call
@@ -121,6 +130,15 @@ def initialize_model_parallel(
         _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
         _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
 
+    if pipeline_model_parallel_split_rank_ is not None:
+        if not 0 < pipeline_model_parallel_split_rank_ < pp:
+            raise RuntimeError(
+                f"pipeline_model_parallel_split_rank "
+                f"({pipeline_model_parallel_split_rank_}) must be inside "
+                f"the pipeline (size {pp})"
+            )
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = pipeline_model_parallel_split_rank_
+
     grid = np.asarray(devices).reshape(dp, pp, cp, tp)
     _MESH = Mesh(
         grid,
@@ -143,9 +161,11 @@ def destroy_model_parallel() -> None:
     """(reference: apex/transformer/parallel_state.py:373-397)"""
     global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
     _MESH = None
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = None
 
 
 def get_mesh() -> Mesh:
@@ -258,15 +278,90 @@ def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = rank
 
 
-def get_num_layers(total_layers: int, is_encoder_and_decoder_model: bool = False) -> int:
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    """Encoder/decoder boundary stage, or None for decoder-only models
+    (reference: apex/transformer/parallel_state.py
+    ``get_pipeline_model_parallel_split_rank``)."""
+    return _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+
+def is_pipeline_stage_before_split(stage: Optional[int] = None) -> bool:
+    """Whether ``stage`` (default: this rank's stage) is an encoder stage
+    of an encoder-and-decoder pipeline (reference:
+    apex/transformer/parallel_state.py ``is_pipeline_stage_before_split``).
+    Always True when no split is configured, matching the reference."""
+    split = _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    if split is None:
+        return True
+    if stage is None:
+        stage = get_pipeline_model_parallel_rank()
+    return stage < split
+
+
+def is_pipeline_stage_after_split(stage: Optional[int] = None) -> bool:
+    """Complement of :func:`is_pipeline_stage_before_split` for decoder
+    stages; True when no split is configured."""
+    split = _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    if split is None:
+        return True
+    if stage is None:
+        stage = get_pipeline_model_parallel_rank()
+    return stage >= split
+
+
+def is_pipeline_stage_at_split(stage: Optional[int] = None) -> bool:
+    """Whether ``stage`` is the last encoder stage, i.e. feeds the first
+    decoder stage (reference: ``is_pipeline_stage_at_split``)."""
+    split = _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    if split is None:
+        return False
+    if stage is None:
+        stage = get_pipeline_model_parallel_rank()
+    return stage == split - 1
+
+
+def get_num_layers(
+    total_layers: int,
+    is_encoder_and_decoder_model: bool = False,
+    decoder_layers: Optional[int] = None,
+    stage: Optional[int] = None,
+) -> int:
     """Layers owned by one pipeline stage
     (reference: apex/transformer/parallel_state.py — layer split logic used
-    by build_model)."""
+    by build_model).
+
+    For ``is_encoder_and_decoder_model``, ``total_layers`` counts the
+    encoder and ``decoder_layers`` the decoder (default: same depth);
+    encoder layers split over the stages before
+    ``pipeline_model_parallel_split_rank`` and decoder layers over the
+    rest (reference: ModelType.encoder_and_decoder handling in
+    schedules/common.py:18-108)."""
     pp = get_pipeline_model_parallel_world_size()
     if is_encoder_and_decoder_model:
-        raise NotImplementedError(
-            "encoder_and_decoder pipeline layer split not yet implemented"
+        split = _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+        if split is None:
+            raise RuntimeError(
+                "encoder_and_decoder pipelines need "
+                "pipeline_model_parallel_split_rank_ at "
+                "initialize_model_parallel time"
+            )
+        dec_layers = (
+            decoder_layers if decoder_layers is not None else total_layers
         )
+        n_enc_stages, n_dec_stages = split, pp - split
+        if total_layers % n_enc_stages:
+            raise ValueError(
+                f"encoder layers ({total_layers}) must be divisible by the "
+                f"number of encoder pipeline stages ({n_enc_stages})"
+            )
+        if dec_layers % n_dec_stages:
+            raise ValueError(
+                f"decoder layers ({dec_layers}) must be divisible by the "
+                f"number of decoder pipeline stages ({n_dec_stages})"
+            )
+        if is_pipeline_stage_before_split(stage):
+            return total_layers // n_enc_stages
+        return dec_layers // n_dec_stages
     if total_layers % pp != 0:
         raise ValueError(
             f"num_layers ({total_layers}) must be divisible by pipeline size ({pp})"

@@ -76,6 +76,38 @@ def _where_tree(cond, a: Any, b: Any) -> Any:
     return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)
 
 
+def _make_stash(zeros_state: Any, num_micro: int) -> Any:
+    """(num_micro, ...) exit-activation stash with the carry's vma."""
+    return jax.tree.map(
+        lambda a: jnp.zeros((num_micro,) + a.shape, a.dtype) + a * 0,
+        zeros_state,
+    )
+
+
+def _stash_add(stash: Any, value: Any, idx, take) -> Any:
+    """Accumulate ``value`` into slot ``idx`` where ``take`` holds."""
+    return jax.tree.map(
+        lambda s, v: s.at[idx].add(jnp.where(take, v, jnp.zeros_like(v))),
+        stash, value,
+    )
+
+
+def _head_pass(last_fn, stash, microbatches, is_exit_stage, axis_name):
+    """Run the pipeline exit exactly once per microbatch over the stashed
+    exit activations (sequential scan keeps a single head's intermediates
+    live at a time), mask to the exit stage, replicate over the axis."""
+
+    def head(_, ym):
+        y, mb = ym
+        return (), last_fn(y, mb)
+
+    _, results = lax.scan(head, (), (stash, microbatches))
+    results = jnp.where(
+        is_exit_stage, results, jnp.zeros_like(results)
+    )
+    return lax.psum(results, axis_name)
+
+
 def pipeline(
     first_fn: Callable[[Any], Any],
     stage_fn: Callable[[Any], Any],
@@ -121,30 +153,37 @@ def pipeline(
     if remat:
         body = jax.checkpoint(stage_fn)
 
-    def tick(state, t):
+    # exit activations accumulate into a (num_micro, ...) stash so the
+    # pipeline exit (LM head + loss — the most expensive single op) runs
+    # exactly num_micro times AFTER the ring scan, not once per tick
+    # (the reference's 1F1B likewise runs loss once per microbatch,
+    # fwd_bwd_pipelining_without_interleaving.py:112-149)
+    stash0 = _make_stash(zeros_state, num_micro)
+
+    def tick(carry, t):
+        state, stash = carry
         # fresh microbatch enters at stage 0 (clamped index; the tail
-        # ticks feed stage 0 garbage that never reaches last_fn's mask)
+        # ticks feed stage 0 garbage that never reaches the exit stash)
         mb_in = _index_microbatch(
             microbatches, jnp.minimum(t, num_micro - 1)
         )
         entry = first_fn(mb_in)
         x = _where_tree(stage == 0, entry, state)
         y = body(x)
-        # exit at the last stage: microbatch index t-(pp-1)
+        # exit at the last stage: microbatch index t-(pp-1); ticks before
+        # the fill add zeros to slot 0
         out_idx = jnp.maximum(t - (pp - 1), 0)
-        mb_out = _index_microbatch(microbatches, out_idx)
-        r = last_fn(y, mb_out)
-        r = jnp.where(stage == pp - 1, r, jnp.zeros_like(r))
+        take = (stage == pp - 1) & (t >= pp - 1)
+        stash = _stash_add(stash, y, out_idx, take)
         # rotate activations to the next stage
         state = send_forward(y, axis_name)
-        return state, r
+        return (state, stash), None
 
-    _, results = lax.scan(tick, zeros_state, jnp.arange(ticks))
-    # keep the ticks where the last stage produced real microbatches,
-    # then replicate them across the pipeline axis (only the last
-    # stage's contribution is nonzero)
-    valid = results[pp - 1 :]
-    return lax.psum(valid, axis_name)
+    (_, stash), _ = lax.scan(
+        tick, (zeros_state, stash0), jnp.arange(ticks)
+    )
+    return _head_pass(last_fn, stash, microbatches, stage == pp - 1,
+                      axis_name)
 
 
 def forward_backward_no_pipelining(
@@ -239,8 +278,12 @@ def forward_backward_pipelining_with_interleaving(
     if remat:
         body = jax.checkpoint(chunk_fn)
 
+    # exit activations stash (see `pipeline`): the LM head runs exactly
+    # num_micro times after the ring scan instead of once per tick
+    stash0 = _make_stash(zeros_state, num_micro)
+
     def tick(carry, t):
-        state, acc = carry
+        state, stash = carry
         # schedule coordinates: rank p at tick t handles microbatch
         # g*pp + m on chunk v, where t - p = g*(V*pp) + v*pp + m
         tau = t - rank
@@ -260,20 +303,17 @@ def forward_backward_pipelining_with_interleaving(
         is_exit = (rank == pp - 1) & (v == V - 1) & (tau >= 0) & (
             mb < num_micro
         )
-        r = last_fn(y, mb_in)
-        r = jnp.where(is_exit, r, jnp.zeros_like(r))
-        acc = acc.at[mb_c].add(r)
+        stash = _stash_add(stash, y, mb_c, is_exit)
 
         state = send_forward(y, axis_name)
-        return (state, acc), None
+        return (state, stash), None
 
-    r0 = last_fn(zeros_state, mb0)  # shape/dtype/vma probe
-    acc0 = _ensure_varying(
-        jnp.zeros((num_micro,) + r0.shape, r0.dtype) + r0 * 0, axis_name
+    (_, stash), _ = lax.scan(
+        tick, (zeros_state, stash0), jnp.arange(ticks)
     )
-    (_, acc), _ = lax.scan(tick, (zeros_state, acc0), jnp.arange(ticks))
-    # only the exit stage accumulated real values
-    return lax.psum(acc, axis_name)
+    # only the exit stage stashed real activations
+    return _head_pass(last_fn, stash, microbatches, rank == pp - 1,
+                      axis_name)
 
 
 def get_forward_backward_func(

@@ -116,3 +116,90 @@ class TestModelParallelGradScaler:
             assert not bool(out)
         finally:
             parallel_state.destroy_model_parallel()
+
+
+class TestCastLists:
+    """Curated cast lists (reference: apex/amp/lists/torch_overrides.py:7-47):
+    the blacklist keeps softmax/log/norm-class ops in fp32 under O1, the
+    whitelist casts BLAS/conv ops to the low-precision dtype, and the
+    O1<->O4 dtype flip reaches every wrapper."""
+
+    def test_blacklist_keeps_fp32_under_o1(self):
+        from apex_tpu.amp import cast_namespaces, set_low_precision_dtype
+
+        set_low_precision_dtype(jnp.float16)  # O1
+        try:
+            ns = cast_namespaces()
+            x16 = jnp.linspace(-4, 4, 64, dtype=jnp.float16)
+            # softmax/log/sum run in fp32 even on fp16 inputs
+            assert ns.nn.softmax(x16).dtype == jnp.float32
+            assert ns.nn.log_softmax(x16).dtype == jnp.float32
+            assert ns.numpy.log(jnp.abs(x16) + 1).dtype == jnp.float32
+            assert ns.numpy.sum(x16).dtype == jnp.float32
+            assert ns.numpy.power(jnp.abs(x16), 3.0).dtype == jnp.float32
+            # fp32 internals, not just an output cast: exp of 12 overflows
+            # fp16 (inf) but is finite in fp32
+            big = jnp.asarray([12.0], jnp.float16)
+            assert bool(jnp.isfinite(ns.numpy.exp(big))[0])
+        finally:
+            set_low_precision_dtype(jnp.bfloat16)
+
+    def test_whitelist_casts_to_low_precision_and_flips(self):
+        from apex_tpu.amp import cast_namespaces, set_low_precision_dtype
+
+        ns = cast_namespaces()
+        a = jnp.ones((8, 8), jnp.float32)
+        set_low_precision_dtype(jnp.float16)  # O1
+        try:
+            assert ns.numpy.matmul(a, a).dtype == jnp.float16
+            assert ns.numpy.einsum("ij,jk->ik", a, a).dtype == jnp.float16
+            set_low_precision_dtype(jnp.bfloat16)  # O4
+            assert ns.numpy.matmul(a, a).dtype == jnp.bfloat16
+            assert ns.lax.dot(a, a).dtype == jnp.bfloat16
+        finally:
+            set_low_precision_dtype(jnp.bfloat16)
+
+    def test_unlisted_passthrough(self):
+        from apex_tpu.amp import cast_namespaces
+
+        ns = cast_namespaces()
+        x = jnp.ones((4,), jnp.float16)
+        # not on any list → untouched dtype semantics
+        assert ns.numpy.abs(x).dtype == jnp.float16
+        assert ns.numpy.zeros((2,)).dtype == jnp.float32
+
+    def test_promote_wrappers(self):
+        from apex_tpu.amp import cast_namespaces
+
+        ns = cast_namespaces()
+        a = jnp.ones((4,), jnp.float16)
+        b = jnp.ones((4,), jnp.float32)
+        assert ns.numpy.add(a, b).dtype == jnp.float32
+        assert ns.numpy.concatenate([a, b]).dtype == jnp.float32
+
+    def test_patch_and_restore(self):
+        from apex_tpu.amp import patch, set_low_precision_dtype
+
+        orig = jnp.matmul
+        a = jnp.ones((4, 4), jnp.float32)
+        set_low_precision_dtype(jnp.bfloat16)
+        with patch():
+            assert jnp.matmul is not orig
+            assert jnp.matmul(a, a).dtype == jnp.bfloat16
+            assert jax.nn.softmax(a[0].astype(jnp.bfloat16)).dtype == jnp.float32
+        assert jnp.matmul is orig
+        assert jnp.matmul(a, a).dtype == jnp.float32
+
+    def test_works_under_jit(self):
+        from apex_tpu.amp import cast_namespaces
+
+        ns = cast_namespaces()
+
+        @jax.jit
+        def f(a, b):
+            h = ns.numpy.matmul(a, b)
+            return ns.nn.softmax(h, axis=-1)
+
+        out = f(jnp.ones((4, 8)), jnp.ones((8, 8)))
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out.sum(-1)), 1.0, rtol=1e-6)

@@ -5,109 +5,176 @@ The reference's L1 tier sweeps opt_levels {O0..O3} x loss_scale
 {none, 1, 128, dynamic} x keep_batchnorm, trains the same model with
 extensions on and off, and compares the saved loss traces bitwise
 (reference: tests/L1/common/run_test.sh:30-60, compare.py).  Here the
-"extension on/off" pair is pallas vs XLA implementations, compared at
-tolerance where fusion changes op order and exactly where achievable
-(scaler math), per SURVEY.md §7's adaptation of the philosophy.
+model is a small tensor-parallel **GPT** (not a toy MLP) on the dp=4 x
+tp=2 virtual mesh, the policy reaches the model through one kwarg
+(``GPTConfig(policy=...)``), and the "extension on/off" pair is pallas
+vs XLA implementations, compared at tolerance where fusion changes op
+order and exactly where achievable, per SURVEY.md §7's adaptation of the
+philosophy.
 """
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu import amp
-from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+from apex_tpu.models.gpt import GPTConfig, GPTModel
 from apex_tpu.optimizers import FusedAdam
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.amp import model_parallel_all_finite
 
 OPT_LEVELS = ["O0", "O1", "O2", "O3", "O4", "O5"]
 LOSS_SCALES = [None, 1.0, 128.0, "dynamic"]
 
-
-def init_model(key):
-    k1, k2 = jax.random.split(key)
-    return {
-        "w1": 0.3 * jax.random.normal(k1, (8, 16)),
-        "b1": jnp.zeros((16,)),
-        "ln": {"scale": jnp.ones((16,)), "bias": jnp.zeros((16,))},
-        "w2": 0.3 * jax.random.normal(k2, (16, 1)),
-        "b2": jnp.zeros((1,)),
-    }
+VOCAB, LAYERS, HIDDEN, HEADS, SEQ, BATCH = 64, 2, 32, 2, 8, 8
 
 
-def apply_model(p, x, ln_impl):
-    h = jax.nn.relu(jnp.matmul(x, p["w1"].astype(x.dtype)) + p["b1"].astype(x.dtype))
-    h = fused_layer_norm_affine(
-        h, p["ln"]["scale"], p["ln"]["bias"], (16,), implementation=ln_impl
+@pytest.fixture(scope="module")
+def mesh():
+    m = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2
     )
-    return jnp.matmul(h, p["w2"].astype(h.dtype)) + p["b2"].astype(h.dtype)
+    yield m
+    parallel_state.destroy_model_parallel()
 
 
-def train_trace(opt_level, loss_scale, ln_impl, steps=20):
-    """Run a small train loop; returns the loss trace."""
+def _data():
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (BATCH, SEQ)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    return tokens, targets
+
+
+def train_trace(mesh, opt_level, loss_scale, attn_impl="xla", steps=10):
+    """Train a policy-driven GPT; returns the loss trace.
+
+    The policy reaches the model via ``GPTConfig(policy=...)`` — the
+    single-kwarg O0..O5 switch (reference UX: amp.initialize and forget,
+    apex/amp/_initialize.py:145-265).
+    """
     overrides = {}
     if loss_scale is not None:
         overrides["loss_scale"] = loss_scale
     mp = amp.initialize(opt_level=opt_level, **overrides)
-    opt = FusedAdam(lr=1e-2)
 
-    params = init_model(jax.random.PRNGKey(0))
-    params, amp_state = mp.init(params)
+    cfg = GPTConfig(
+        vocab_size=VOCAB, num_layers=LAYERS, hidden_size=HIDDEN,
+        num_attention_heads=HEADS, max_position_embeddings=SEQ,
+        policy=mp.policy, remat=False, attention_impl=attn_impl,
+    )
+    model = GPTModel(cfg)
+    # the policy reached the model: params carry its dtype (norms fp32
+    # when it says so), and the train loop derives scaler + masters
+    opt = FusedAdam(lr=1e-2, master_weights=mp.policy.master_weights)
+
+    params = model.init(jax.random.PRNGKey(0))
+    specs = model.param_specs()
+    amp_state = mp.init()
     opt_state = opt.init(params)
+    state_specs = {
+        k: (jax.tree.map(lambda _: P(), v) if k == "step"
+            else jax.tree.map(
+                lambda s: s, specs, is_leaf=lambda x: isinstance(x, P)))
+        for k, v in opt_state.items()
+    }
+    tokens, targets = _data()
 
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
-    y = jnp.sum(x[:, :2], axis=1, keepdims=True)
-
-    @jax.jit
-    def step(params, opt_state, amp_state, x, y):
+    def step(params, opt_state, amp_state, tokens, targets):
         def loss_fn(p):
-            h = apply_model(
-                mp.policy.cast_to_compute(p),
-                x.astype(mp.policy.compute_dtype or x.dtype),
-                ln_impl,
-            )
-            loss = jnp.mean((h.astype(jnp.float32) - y) ** 2)
+            loss = model.loss(p, tokens, targets)
             return mp.scale_loss(amp_state, loss), loss
 
         grads, loss = jax.grad(loss_fn, has_aux=True)(params)
-        grads, finite, new_amp = mp.unscale_and_adjust(amp_state, grads)
+        # dp average + tp consensus for tp-replicated params (their grads
+        # are identical across tp ranks; pmean re-establishes invariance)
+        flat_specs = jax.tree.leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        flat_grads = jax.tree.leaves(grads)
+
+        def sync(g, s):
+            g = jax.lax.pmean(g, "dp")
+            names = [n for e in s if e
+                     for n in ((e,) if isinstance(e, str) else e)]
+            if "tp" not in names:
+                g = jax.lax.pmean(g, "tp")
+            return g
+
+        grads = jax.tree.unflatten(
+            jax.tree.structure(grads),
+            [sync(g, s) for g, s in zip(flat_grads, flat_specs)],
+        )
+        # inf consensus across the model-parallel axes (the reference's
+        # MP GradScaler found_inf all-reduce) happens inside the adjust
+        grads, finite, new_amp = mp.unscale_and_adjust(
+            amp_state, grads, finite_reduce=model_parallel_all_finite
+        )
         new_params, new_opt = opt.step(
             opt_state, grads, params, grads_finite=finite
         )
-        return new_params, new_opt, new_amp, loss
+        return new_params, new_opt, new_amp, jax.lax.pmean(loss, "dp")
 
+    amp_specs = jax.tree.map(lambda _: P(), amp_state)
+    sharded = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(specs, state_specs, amp_specs, P("dp"), P("dp")),
+        out_specs=(specs, state_specs, amp_specs, P()),
+    ))
+    placed = jax.device_put(
+        params,
+        jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                     is_leaf=lambda x: isinstance(x, P)),
+    )
     trace = []
     for _ in range(steps):
-        params, opt_state, amp_state, loss = step(
-            params, opt_state, amp_state, x, y
+        placed, opt_state, amp_state, loss = sharded(
+            placed, opt_state, amp_state, tokens, targets
         )
         trace.append(float(loss))
-    return np.asarray(trace)
+    return np.asarray(trace), placed
 
 
 @pytest.mark.parametrize("opt_level", OPT_LEVELS)
 @pytest.mark.parametrize("loss_scale", LOSS_SCALES)
-def test_policy_by_scale_converges(opt_level, loss_scale):
-    """Every (opt_level, loss_scale) cell trains and improves."""
+def test_policy_by_scale_converges(mesh, opt_level, loss_scale):
+    """Every (opt_level, loss_scale) cell trains the GPT and improves."""
     if opt_level in ("O0", "O4", "O5") and isinstance(loss_scale, float):
         pytest.skip("fp32/bf16 levels don't use loss scaling")
-    trace = train_trace(opt_level, loss_scale, ln_impl="xla")
+    trace, _ = train_trace(mesh, opt_level, loss_scale)
     assert np.all(np.isfinite(trace))
     assert trace[-1] < trace[0]
 
 
+def test_policy_drives_model_dtypes(mesh):
+    """One kwarg flips the whole model: O2 → fp16 params with fp32
+    norms, masters in the optimizer; O5 → bf16 params, fp32 norms."""
+    for level, low in (("O2", jnp.float16), ("O5", jnp.bfloat16)):
+        mp = amp.initialize(opt_level=level)
+        cfg = GPTConfig(
+            vocab_size=VOCAB, num_layers=LAYERS, hidden_size=HIDDEN,
+            num_attention_heads=HEADS, max_position_embeddings=SEQ,
+            policy=mp.policy, remat=False,
+        )
+        params = GPTModel(cfg).init(jax.random.PRNGKey(0))
+        assert params["embedding"]["weight"].dtype == low
+        assert params["layers"]["ln1"]["scale"].dtype == jnp.float32
+        assert mp.policy.master_weights
+
+
 @pytest.mark.parametrize("opt_level", ["O0", "O2", "O5"])
-def test_kernel_paths_agree(opt_level):
-    """pallas(interpret) vs XLA layernorm paths give near-identical
-    loss traces — the ext-on vs ext-off comparison."""
-    a = train_trace(opt_level, None, ln_impl="xla")
-    b = train_trace(opt_level, None, ln_impl="pallas")
-    np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+def test_kernel_paths_agree(mesh, opt_level):
+    """pallas(interpret) vs XLA attention paths give near-identical loss
+    traces — the ext-on vs ext-off comparison."""
+    a, _ = train_trace(mesh, opt_level, None, attn_impl="xla", steps=6)
+    b, _ = train_trace(mesh, opt_level, None, attn_impl="pallas", steps=6)
+    np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
 
 
-def test_o0_trace_is_bitwise_deterministic():
+def test_o0_trace_is_bitwise_deterministic(mesh):
     """Exactness where achievable (reference asserts bitwise equality):
     two identical fp32 runs must agree bit-for-bit."""
-    a = train_trace("O0", None, ln_impl="xla")
-    b = train_trace("O0", None, ln_impl="xla")
+    a, _ = train_trace(mesh, "O0", None)
+    b, _ = train_trace(mesh, "O0", None)
     np.testing.assert_array_equal(a, b)

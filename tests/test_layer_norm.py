@@ -173,30 +173,40 @@ class TestKernelFallbackPolicy:
             fused_layer_norm(x, 64, implementation="pallas")
 
     def test_strict_env_raises_in_auto_mode(self, monkeypatch):
+        # flash attention is the kernel whose auto mode resolves to
+        # pallas on TPU (layernorm/softmax auto-route to XLA by
+        # measurement, so strict mode does not apply to them)
+        from apex_tpu.ops import attention as attn_mod
         from apex_tpu.ops.common import KernelLoweringError
         from apex_tpu.utils import platform as plat
 
-        self._broken(monkeypatch)
-        # force auto-mode to resolve to pallas as it would on TPU
+        def boom(*a, **k):
+            raise RuntimeError("mosaic lowering exploded")
+
+        monkeypatch.setattr(attn_mod, "_flash_attention_pallas", boom)
         monkeypatch.setattr(plat, "_current_platform", lambda: "tpu")
         monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
         monkeypatch.setenv("APEX_TPU_STRICT_KERNELS", "1")
-        x = jnp.ones((4, 64))
+        q = jnp.ones((1, 1, 8, 8))
         with pytest.raises(KernelLoweringError):
-            fused_layer_norm(x, 64, implementation=None)
+            attn_mod.flash_attention(q, q, q, implementation=None)
 
     def test_auto_mode_falls_back_with_warning(self, monkeypatch, caplog):
         import logging
 
+        from apex_tpu.ops import attention as attn_mod
         from apex_tpu.utils import platform as plat
 
-        self._broken(monkeypatch)
+        def boom(*a, **k):
+            raise RuntimeError("mosaic lowering exploded")
+
+        monkeypatch.setattr(attn_mod, "_flash_attention_pallas", boom)
         monkeypatch.setattr(plat, "_current_platform", lambda: "tpu")
         monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
         monkeypatch.delenv("APEX_TPU_STRICT_KERNELS", raising=False)
-        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64))
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 8, 8))
         with caplog.at_level(logging.WARNING, logger="apex_tpu"):
-            out = fused_layer_norm(x, 64, implementation=None)
+            out = attn_mod.flash_attention(q, q, q, implementation=None)
         assert any("falling back to XLA" in r.message for r in caplog.records)
-        want = fused_layer_norm(x, 64, implementation="xla")
+        want = attn_mod.mha_reference(q, q, q)
         np.testing.assert_allclose(out, want, atol=1e-6)

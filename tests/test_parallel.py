@@ -193,3 +193,119 @@ class TestSyncBatchNorm:
             x, None, None, rm, rv, training=False
         )
         np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-5)
+
+
+class TestConvertSyncBN:
+    """Recursive BatchNorm -> SyncBatchNorm conversion
+    (reference: apex/parallel/__init__.py:21-95)."""
+
+    def _model(self):
+        import flax.linen as nn
+
+        class Block(nn.Module):
+            feats: int
+            norm: nn.Module = None
+
+            @nn.compact
+            def __call__(self, x, train):
+                x = nn.Dense(self.feats)(x)
+                x = self.norm(x, use_running_average=not train) \
+                    if self.norm is not None else x
+                return jax.nn.relu(x)
+
+        class Net(nn.Module):
+            block: nn.Module
+
+            @nn.compact
+            def __call__(self, x, train):
+                x = self.block(x, train)
+                return nn.Dense(4)(x)
+
+        import flax.linen as nn2
+        bn = nn2.BatchNorm(momentum=0.9, epsilon=1e-5)
+        return Net(block=Block(feats=8, norm=bn))
+
+    def test_recursive_swap_preserves_hparams(self):
+        from apex_tpu.parallel import SyncBatchNorm, convert_syncbn_model
+
+        net = self._model()
+        conv = convert_syncbn_model(net, process_group_size=2)
+        sbn = conv.block.norm
+        assert isinstance(sbn, SyncBatchNorm)
+        assert sbn.eps == 1e-5
+        # flax momentum (ra decay) 0.9 -> torch-style update weight 0.1
+        assert abs(sbn.momentum - 0.1) < 1e-9
+        assert sbn.process_group_size == 2
+        # untouched parts survive
+        assert conv.block.feats == 8
+
+    def test_converted_model_matches_full_batch_bn(self):
+        """SyncBN over dp shards == plain BN over the full batch."""
+        import flax.linen as nn
+
+        from apex_tpu.parallel import convert_syncbn_model
+        from apex_tpu.transformer import parallel_state
+
+        mesh = parallel_state.initialize_model_parallel()
+        try:
+            net = self._model()
+            conv = convert_syncbn_model(net)
+            x = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+
+            ref_vars = net.init(jax.random.PRNGKey(1), x, train=True)
+            out_ref, _ = net.apply(
+                ref_vars, x, train=True, mutable=["batch_stats"]
+            )
+
+            conv_vars = conv.init(jax.random.PRNGKey(1), x, train=False)
+
+            def fwd(v, xs):
+                out, upd = conv.apply(
+                    v, xs, train=True, mutable=["batch_stats"]
+                )
+                return out
+
+            sharded = jax.jit(jax.shard_map(
+                fwd, mesh=mesh,
+                in_specs=(P(), P("dp")), out_specs=P("dp"),
+                check_vma=False,
+            ))
+            out_sync = sharded(conv_vars, x)
+            np.testing.assert_allclose(
+                np.asarray(out_sync), np.asarray(out_ref),
+                rtol=1e-5, atol=1e-5,
+            )
+        finally:
+            parallel_state.destroy_model_parallel()
+
+    def test_variables_rename(self):
+        from apex_tpu.parallel import convert_syncbn_variables
+
+        vars_in = {
+            "params": {
+                "bn": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+                # LayerNorm also has a 'scale' param but no running stats:
+                # it must NOT be renamed
+                "ln": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+                "dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.zeros((4,))},
+            },
+            "batch_stats": {
+                "bn": {"mean": jnp.zeros((4,)), "var": jnp.ones((4,))},
+            },
+        }
+        out = convert_syncbn_variables(vars_in)
+        assert "weight" in out["params"]["bn"]
+        assert "bias" in out["params"]["bn"]
+        assert "scale" in out["params"]["ln"]      # LayerNorm untouched
+        assert "weight" not in out["params"]["ln"]
+        assert "kernel" in out["params"]["dense"]  # untouched
+        assert "running_mean" in out["batch_stats"]["bn"]
+        assert "running_var" in out["batch_stats"]["bn"]
+
+    def test_scale_only_bn_refused(self):
+        import flax.linen as nn
+
+        from apex_tpu.parallel import convert_syncbn_model
+
+        with pytest.raises(ValueError, match="use_scale"):
+            convert_syncbn_model(nn.BatchNorm(use_scale=True, use_bias=False))

@@ -1,6 +1,7 @@
 """Profiling subsystem tests."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -66,3 +67,41 @@ def test_timers():
     except AssertionError:
         raised = True
     assert raised
+
+
+def test_parse_per_op_table(tmp_path):
+    """Trace a jitted step, parse the xplane file into per-op rows
+    (reference: apex/pyprof/parse/parse.py -> prof per-op tables)."""
+    from apex_tpu.pyprof import op_table, parse, trace
+
+    @jax.jit
+    def step(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    x = jnp.ones((128, 128))
+    w = jnp.ones((128, 128))
+    jax.block_until_ready(step(x, w))  # compile outside the trace
+    log_dir = str(tmp_path / "trace")
+    with trace(log_dir):
+        for _ in range(3):
+            jax.block_until_ready(step(x, w))
+
+    rows = parse(log_dir)
+    assert rows, "parse returned no rows"
+    names = " ".join(r["name"] for r in rows)
+    # the dot kernel must show up as a device event
+    assert "dot" in names or "tanh" in names, names[:500]
+    r0 = rows[0]
+    assert r0["count"] >= 1 and r0["total_ms"] > 0
+    assert abs(sum(r["pct"] for r in rows) - 100.0) < 1e-6
+    # repeated events aggregate: some op should have count >= 3
+    assert any(r["count"] >= 3 for r in rows)
+    table = op_table(rows)
+    assert "total ms" in table and rows[0]["name"][:20] in table
+
+
+def test_parse_missing_dir_raises(tmp_path):
+    from apex_tpu.pyprof import parse
+
+    with pytest.raises(FileNotFoundError):
+        parse(str(tmp_path / "nope"))

@@ -441,3 +441,17 @@ class TestFlashAttentionExtras:
         # auto mode still degrades gracefully
         out = attn_mod.flash_attention(q, k, v)
         assert out.shape == (1, 1, 8, 8)
+
+
+def test_masked_softmax_explicit_pallas_raises():
+    """No silent degradation: the masked variant has no pallas kernel,
+    so an explicit request errors instead of silently running XLA."""
+    from apex_tpu.ops.common import KernelLoweringError
+
+    x = jnp.zeros((1, 8, 8))
+    mask = jnp.zeros((1, 8, 8), bool)
+    with pytest.raises(KernelLoweringError):
+        scaled_masked_softmax(x, mask, implementation="pallas")
+    # auto + explicit xla still fine
+    out = scaled_masked_softmax(x, mask)
+    assert out.shape == (1, 8, 8)

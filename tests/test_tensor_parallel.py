@@ -198,3 +198,59 @@ def test_vocab_parallel_cross_entropy(mesh):
     want_g = jax.grad(dense_mean_loss)(logits, target)
     np.testing.assert_allclose(np.asarray(got_g), np.asarray(want_g),
                                rtol=1e-4, atol=1e-5)
+
+
+class TestEncoderDecoderSplit:
+    """ModelType.encoder_and_decoder pipeline layer split
+    (reference: schedules/common.py:18-108, parallel_state split rank)."""
+
+    def test_split_layer_math(self):
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size_=4,
+            pipeline_model_parallel_split_rank_=1,
+        )
+        try:
+            assert parallel_state.get_pipeline_model_parallel_split_rank() == 1
+            # 6 encoder layers on 1 stage; 9 decoder layers on 3 stages
+            assert parallel_state.get_num_layers(
+                6, is_encoder_and_decoder_model=True, decoder_layers=9,
+                stage=0,
+            ) == 6
+            assert parallel_state.get_num_layers(
+                6, is_encoder_and_decoder_model=True, decoder_layers=9,
+                stage=2,
+            ) == 3
+            assert parallel_state.is_pipeline_stage_before_split(0)
+            assert not parallel_state.is_pipeline_stage_before_split(1)
+            assert parallel_state.is_pipeline_stage_after_split(1)
+            assert parallel_state.is_pipeline_stage_at_split(0)
+            with pytest.raises(ValueError):
+                parallel_state.get_num_layers(
+                    7, is_encoder_and_decoder_model=True, stage=3
+                )
+        finally:
+            parallel_state.destroy_model_parallel()
+
+    def test_split_requires_configuration(self):
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size_=4
+        )
+        try:
+            with pytest.raises(RuntimeError):
+                parallel_state.get_num_layers(
+                    8, is_encoder_and_decoder_model=True
+                )
+            # no split configured: every stage counts as both sides,
+            # matching the reference's defaults
+            assert parallel_state.is_pipeline_stage_before_split(3)
+            assert parallel_state.is_pipeline_stage_after_split(0)
+        finally:
+            parallel_state.destroy_model_parallel()
+
+    def test_split_rank_bounds(self):
+        with pytest.raises(RuntimeError):
+            parallel_state.initialize_model_parallel(
+                pipeline_model_parallel_size_=4,
+                pipeline_model_parallel_split_rank_=4,
+            )
+        parallel_state.destroy_model_parallel()

@@ -1,0 +1,360 @@
+"""On-TPU kernel validation: pallas-vs-xla parity AND timing.
+
+The TPU analog of the reference's fast-vs-default cross-check
+(/root/reference/apex/contrib/multihead_attn/self_multihead_attn.py:26-124)
+and its bitwise L1 tier (/root/reference/tests/L1/common/run_test.sh:118-137):
+every Pallas kernel is validated against the XLA path on the real chip —
+numerically (max abs err vs an fp32 reference) and for speed (median wall
+time), with a block-size sweep for flash attention.
+
+Writes KERNELS_TPU.json at the repo root.  Run:
+
+    python tools/kernel_validation.py            # full sweep
+    python tools/kernel_validation.py --smoke    # one shape per kernel
+
+Strict mode: every pallas call here goes through implementation='pallas',
+so a Mosaic lowering regression raises KernelLoweringError instead of
+silently timing the XLA fallback (ops/common.py run_kernel contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def _require_tpu():
+    plat = jax.devices()[0].platform
+    if plat not in ("tpu", "axon"):
+        raise SystemExit(f"kernel validation must run on TPU (got {plat})")
+
+
+def _time(fn, *args, iters=100, warmup=1):
+    """Amortized ms/call with a device-side repeat loop.
+
+    Two tunnel-backend gotchas (same as bench.py): block_until_ready
+    returns before device execution completes (so the result is
+    device_get), and per-dispatch latency is ~3.6 ms (so host-side call
+    loops measure dispatch, not the kernel).  The loop therefore runs on
+    device via fori_loop, with the scalar carry folded into the first
+    operand at 1e-30 scale to build a data dependence the compiler cannot
+    hoist.  Residual bias: one dispatch / ``iters`` ≈ 36 µs at the
+    default 100 — identical for both implementations being compared.
+    ``fn`` must return a scalar (4-byte readback).
+    """
+
+    @jax.jit
+    def looped(*a):
+        def body(_, acc):
+            first = (a[0].astype(jnp.float32) + acc * 1e-30).astype(
+                a[0].dtype
+            )
+            return fn(first, *a[1:]).astype(jnp.float32)
+
+        return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+    for _ in range(warmup):
+        jax.device_get(looped(*args))
+    t0 = time.perf_counter()
+    jax.device_get(looped(*args))
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def _max_err(a, b):
+    return float(
+        jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
+    )
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+
+def validate_flash(smoke=False):
+    from apex_tpu.ops.attention import flash_attention, mha_reference
+
+    results = []
+    shapes = [(4, 8, 1024, 128), (2, 8, 4096, 128), (1, 4, 8192, 128)]
+    dtypes = [jnp.bfloat16, jnp.float32]
+    blocks = [(256, 256), (512, 512), (256, 512), (512, 1024),
+              (1024, 1024)]
+    if smoke:
+        shapes, dtypes, blocks = shapes[:1], dtypes[:1], blocks[:2]
+
+    for shape in shapes:
+        b, h, s, d = shape
+        for dtype in dtypes:
+            kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+            q = jax.random.normal(kq, shape, dtype)
+            k = jax.random.normal(kk, shape, dtype)
+            v = jax.random.normal(kv, shape, dtype)
+
+            def fwd(impl, bq, bk):
+                # returns the full tensor (for parity checks)
+                return jax.jit(lambda q, k, v: flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk,
+                    implementation=impl,
+                ))
+
+            def fwd_t(impl, bq, bk):
+                # scalar-returning variant for timing (4-byte readback)
+                return jax.jit(lambda q, k, v: jnp.sum(flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk,
+                    implementation=impl,
+                ).astype(jnp.float32)))
+
+            def loss(impl, bq, bk):
+                def f(q, k, v):
+                    return jnp.sum(flash_attention(
+                        q, k, v, causal=True, block_q=bq, block_k=bk,
+                        implementation=impl,
+                    ).astype(jnp.float32) ** 2)
+                return jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))
+
+            def loss_t(impl, bq, bk):
+                lfn = loss(impl, bq, bk)
+
+                def timed(q, k, v):
+                    val, grads = lfn(q, k, v)
+                    return val + sum(
+                        jnp.sum(g.astype(jnp.float32) ** 2) for g in grads
+                    )
+                return jax.jit(timed)
+
+            # fp32 ground truth for parity (computed once, in fp32)
+            ref = mha_reference(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                v.astype(jnp.float32), causal=True,
+            )
+
+            sweep = {}
+            best = None
+            for bq, bk in blocks:
+                if bq > s or bk > s:
+                    continue
+                try:
+                    f = fwd_t("pallas", bq, bk)
+                    ms = _time(f, q, k, v)
+                except Exception as e:  # lowering failure = loud entry
+                    sweep[f"{bq}x{bk}"] = {"error": str(e)[:200]}
+                    continue
+                sweep[f"{bq}x{bk}"] = round(ms, 3)
+                if best is None or ms < best[0]:
+                    best = (ms, bq, bk)
+            assert best is not None, f"no block config lowered for {shape}"
+            _, bq, bk = best
+
+            out_p = jax.device_get(fwd("pallas", bq, bk)(q, k, v))
+            out_x = jax.device_get(fwd("xla", bq, bk)(q, k, v))
+            xla_ms = _time(fwd_t("xla", bq, bk), q, k, v)
+
+            # backward: pallas vs xla timing + grad parity
+            vp, gp = loss("pallas", bq, bk)(q, k, v)
+            vx, gx = loss("xla", bq, bk)(q, k, v)
+            gp, gx = jax.device_get((gp, gx))
+            bwd_p_ms = _time(loss_t("pallas", bq, bk), q, k, v, iters=30)
+            bwd_x_ms = _time(loss_t("xla", bq, bk), q, k, v, iters=30)
+            # causal attention FLOPs: 4*b*h*s^2*d mults, halved by masking
+            flops = 2.0 * b * h * s * s * d  # fwd qk + pv, causal half
+            results.append({
+                "kernel": "flash_attention",
+                "shape": list(shape),
+                "dtype": jnp.dtype(dtype).name,
+                "causal": True,
+                "best_block": [bq, bk],
+                "block_sweep_ms": sweep,
+                "fwd": {
+                    "pallas_ms": round(best[0], 3),
+                    "xla_ms": round(xla_ms, 3),
+                    "speedup": round(xla_ms / best[0], 2),
+                    "pallas_tflops": round(flops / best[0] / 1e9, 1),
+                    "max_err_vs_fp32": _max_err(out_p, ref),
+                    "xla_err_vs_fp32": _max_err(out_x, ref),
+                },
+                "fwd_bwd": {
+                    "pallas_ms": round(bwd_p_ms, 3),
+                    "xla_ms": round(bwd_x_ms, 3),
+                    "speedup": round(bwd_x_ms / bwd_p_ms, 2),
+                    "grad_max_rel_err": max(
+                        _max_err(a, bb) / (float(jnp.max(jnp.abs(
+                            bb.astype(jnp.float32)))) + 1e-6)
+                        for a, bb in zip(gp, gx)
+                    ),
+                },
+            })
+            print(json.dumps(results[-1]))
+    return results
+
+
+# ---------------------------------------------------------------------------
+# fused layer norm
+# ---------------------------------------------------------------------------
+
+
+def validate_layer_norm(smoke=False):
+    from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+    results = []
+    shapes = [(16384, 1024), (8192, 4096), (4096, 8192)]
+    dtypes = [jnp.bfloat16, jnp.float32]
+    if smoke:
+        shapes, dtypes = shapes[:1], dtypes[:1]
+    for rows, hidden in shapes:
+        for dtype in dtypes:
+            x = jax.random.normal(jax.random.PRNGKey(1), (rows, hidden), dtype)
+            w = jnp.ones((hidden,), jnp.float32)
+            bias = jnp.zeros((hidden,), jnp.float32)
+
+            def f(impl):
+                return jax.jit(lambda x: fused_layer_norm_affine(
+                    x, w, bias, (hidden,), implementation=impl
+                ))
+
+            def f_t(impl):
+                return jax.jit(lambda x: jnp.sum(fused_layer_norm_affine(
+                    x, w, bias, (hidden,), implementation=impl
+                ).astype(jnp.float32)))
+
+            ref = jax.device_get(f("xla")(x.astype(jnp.float32)))
+            out_p = jax.device_get(f("pallas")(x))
+            p_ms = _time(f_t("pallas"), x)
+            x_ms = _time(f_t("xla"), x)
+            gb = 2 * rows * hidden * jnp.dtype(dtype).itemsize / 1e9
+            results.append({
+                "kernel": "fused_layer_norm",
+                "shape": [rows, hidden],
+                "dtype": jnp.dtype(dtype).name,
+                "pallas_ms": round(p_ms, 3),
+                "xla_ms": round(x_ms, 3),
+                "speedup": round(x_ms / p_ms, 2),
+                "pallas_gbps": round(gb / (p_ms / 1e3), 1),
+                "max_err_vs_fp32": _max_err(out_p, ref),
+                # layernorm auto-routes to XLA by these measurements
+                # (ops/layer_norm.py); kernel kept for the cross-check tier
+                "auto_impl": "xla",
+            })
+            print(json.dumps(results[-1]))
+    return results
+
+
+# ---------------------------------------------------------------------------
+# scaled (masked) softmax
+# ---------------------------------------------------------------------------
+
+
+def validate_softmax(smoke=False):
+    from apex_tpu.ops.softmax import (
+        scaled_softmax,
+        scaled_upper_triang_masked_softmax,
+    )
+
+    results = []
+    cases = [
+        ("scaled_softmax", scaled_softmax, (32, 1024, 1024)),
+        ("scaled_upper_triang_masked_softmax",
+         scaled_upper_triang_masked_softmax, (32, 1024, 1024)),
+        ("scaled_softmax", scaled_softmax, (8, 2048, 2048)),
+        ("scaled_upper_triang_masked_softmax",
+         scaled_upper_triang_masked_softmax, (8, 2048, 2048)),
+    ]
+    dtypes = [jnp.bfloat16, jnp.float32]
+    if smoke:
+        cases, dtypes = cases[:1], dtypes[:1]
+    for name, fn, shape in cases:
+        for dtype in dtypes:
+            x = jax.random.normal(jax.random.PRNGKey(2), shape, dtype)
+
+            def f(impl):
+                return jax.jit(lambda x: fn(x, 1.3, implementation=impl))
+
+            def f_t(impl):
+                return jax.jit(lambda x: jnp.sum(
+                    fn(x, 1.3, implementation=impl).astype(jnp.float32)
+                ))
+
+            ref = jax.device_get(f("xla")(x.astype(jnp.float32)))
+            out_p = jax.device_get(f("pallas")(x))
+            p_ms = _time(f_t("pallas"), x)
+            x_ms = _time(f_t("xla"), x)
+            results.append({
+                "kernel": name,
+                "shape": list(shape),
+                "dtype": jnp.dtype(dtype).name,
+                "pallas_ms": round(p_ms, 3),
+                "xla_ms": round(x_ms, 3),
+                "speedup": round(x_ms / p_ms, 2),
+                "max_err_vs_fp32": _max_err(out_p, ref),
+                # standalone softmax auto-routes to XLA by measurement
+                # (ops/softmax.py); the kernel is kept for the cross-check
+                # tier and superseded by flash attention in real models
+                "auto_impl": "xla",
+            })
+            print(json.dumps(results[-1]))
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "KERNELS_TPU.json",
+    ))
+    args = ap.parse_args()
+    _require_tpu()
+    t0 = time.time()
+    entries = []
+    entries += validate_flash(smoke=args.smoke)
+    entries += validate_layer_norm(smoke=args.smoke)
+    entries += validate_softmax(smoke=args.smoke)
+    doc = {
+        "device": str(jax.devices()[0]),
+        "jax_version": jax.__version__,
+        "smoke": bool(args.smoke),
+        "wall_s": round(time.time() - t0, 1),
+        "entries": entries,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {args.out} ({len(entries)} entries, "
+          f"{doc['wall_s']}s)")
+    # summary gates:
+    # (1) numeric: the pallas path must track the fp32 reference about as
+    #     tightly as the XLA path does (TPU default matmul precision puts
+    #     a bf16-pass noise floor under BOTH paths, so the bound is
+    #     relative), and backward grads must agree with XLA
+    bad = []
+    for e in entries:
+        f = e.get("fwd", e)
+        err = f.get("max_err_vs_fp32", 0.0)
+        ref_err = max(f.get("xla_err_vs_fp32", 0.0), 1e-3)
+        if err > 5 * ref_err:
+            bad.append((e, f"fwd err {err} > 5x xla err {ref_err}"))
+        grad_err = e.get("fwd_bwd", {}).get("grad_max_rel_err", 0.0)
+        if grad_err > 0.1:
+            bad.append((e, f"grad rel err {grad_err} > 0.1"))
+    # (2) speed: every kernel whose AUTO mode picks pallas must be at
+    #     least at parity with XLA (kernels that auto-route to XLA are
+    #     recorded measurements, not regressions)
+    for e in entries:
+        if (e.get("auto_impl", "pallas") == "pallas"
+                and e.get("fwd", e).get("speedup", 1.0) < 1.0):
+            bad.append((e, "pallas slower than xla on an auto-pallas path"))
+    for e, why in bad:
+        print(f"GATE FAIL: {e['kernel']} {e['shape']} {e['dtype']}: {why}")
+    if bad:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()
