@@ -98,6 +98,9 @@ def child_gpt(platform: str):
         num_attention_heads=8 if on_tpu else 4,
     )
     BATCH = 8 if on_tpu else 2
+    # MFU is batch-sensitive: the fast path sweeps these and keeps the
+    # best (HBM permitting), the baseline uses BATCH for comparability
+    FAST_BATCHES = (8, 16, 32) if on_tpu else (2,)
     SEQ = 1024 if on_tpu else 256
     WARMUP = 2
     STEPS = 10 if on_tpu else 4
@@ -150,11 +153,11 @@ def child_gpt(platform: str):
             params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
         return place(params, specs), place(opt_state, opt_specs), step, n_params
 
-    def run(fast: bool):
+    def run(fast: bool, batch: int):
         params, opt_state, step, n_params = build_step(fast)
         key = jax.random.PRNGKey(1)
         tokens = jax.random.randint(
-            key, (BATCH, SEQ), 0, cfg_common["vocab_size"]
+            key, (batch, SEQ), 0, cfg_common["vocab_size"]
         )
         targets = jnp.roll(tokens, -1, axis=1)
         for _ in range(WARMUP):
@@ -169,14 +172,32 @@ def child_gpt(platform: str):
         final_loss = float(loss)
         dt = time.perf_counter() - t0
         assert jnp.isfinite(final_loss), "non-finite loss in benchmark"
-        tps = BATCH * SEQ * STEPS / dt
-        log(f"{'fast' if fast else 'base'}: {dt/STEPS*1e3:.1f} ms/step, "
-            f"{tps:,.0f} tokens/s, loss {final_loss:.3f}")
+        tps = batch * SEQ * STEPS / dt
+        log(f"{'fast' if fast else 'base'} b={batch}: "
+            f"{dt/STEPS*1e3:.1f} ms/step, {tps:,.0f} tokens/s, "
+            f"loss {final_loss:.3f}")
         return tps, n_params
 
     log(f"devices: {jax.devices()}")
-    base, _ = run(fast=False)
-    fast, n_params = run(fast=True)
+    base, _ = run(fast=False, batch=BATCH)
+    fast, best_batch, n_params = 0.0, BATCH, 0
+    fast_matched = None  # fast-path tokens/s at the baseline's batch
+    last_err = None
+    for b in FAST_BATCHES:
+        try:
+            tps, n_params = run(fast=True, batch=b)
+        except AssertionError:
+            raise  # non-finite loss is a correctness failure, never OOM
+        except Exception as e:  # HBM OOM at the largest batches
+            last_err = e
+            log(f"fast b={b} failed ({str(e)[:120]}); keeping best so far")
+            break
+        if b == BATCH:
+            fast_matched = tps
+        if tps > fast:
+            fast, best_batch = tps, b
+    if fast == 0.0:
+        raise RuntimeError("fast path failed at every batch") from last_err
 
     # model FLOPs per token: 6*N (fwd+bwd matmuls) + 12*L*h*s attention
     flops_per_token = (
@@ -189,12 +210,19 @@ def child_gpt(platform: str):
         "metric": "gpt_tp1_tokens_per_sec",
         "value": round(fast, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(fast / base, 3),
+        # matched-batch comparison isolates the fast-path changes (bf16 +
+        # flash + fused masters); batch-size scaling is reported via
+        # value@best_batch separately
+        "vs_baseline": round((fast_matched or fast) / base, 3),
         "platform": platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", ""),
         "mfu": mfu,
         "n_params": n_params,
-        "ms_per_step": round(BATCH * SEQ / fast * 1e3, 2),
+        "batch": best_batch,
+        "seq": SEQ,
+        "steps": STEPS,
+        "warmup": WARMUP,
+        "ms_per_step": round(best_batch * SEQ / fast * 1e3, 2),
         **({} if on_tpu else {"note": (
             "cpu fallback (TPU unreachable): bf16 has no CPU matrix "
             "units, so vs_baseline is not representative of TPU"
@@ -268,6 +296,12 @@ def child_extras(platform: str):
     out["rn50_batch"] = batch
     out["rn50_depth"] = model.config.depth
     out["rn50_image_size"] = size
+    # measurement spec, so regressions are reproducible (VERDICT r2 #9)
+    out["rn50_spec"] = {
+        "steps": steps, "warmup": 2, "compute_dtype": "bfloat16",
+        "params_dtype": "bfloat16 + fp32 masters (O2-analog)",
+        "optimizer": "FusedAdam(master_weights=True)",
+    }
     log(f"rn50: {out['rn50_images_per_sec']} images/s (batch {batch})")
 
     # ---- FusedLAMB (one jitted pytree step) vs unfused LAMB (same math,
@@ -359,8 +393,104 @@ def child_extras(platform: str):
     out["lamb_speedup"] = round(
         out["unfused_lamb_ms"] / out["fused_lamb_ms"], 2
     )
+    out["lamb_spec"] = {
+        "timeit_iters": 20, "warmup": 1, "dtype": "float32",
+        "shape": f"BERT-large-ish h={h} L={L} vocab={vocab} "
+                 f"({1 + 4 * L} tensors)",
+        "use_nvlamb": True,
+    }
     log(f"lamb fused {out['fused_lamb_ms']} ms vs unfused "
         f"{out['unfused_lamb_ms']} ms ({out['lamb_speedup']}x)")
+
+    # ---- DCGAN-style multi-model / multi-loss-scaler step (BASELINE.md:
+    # 'DCGAN multi-model/multi-loss scaling, functional, 3 loss scalers')
+    from apex_tpu import amp as apex_amp
+
+    mp = apex_amp.initialize(opt_level="O1", num_losses=3)
+    gb, zdim, img = (64, 64, 784) if on_tpu else (16, 16, 64)
+    kG, kD, kz = jax.random.split(jax.random.PRNGKey(4), 3)
+    G = {"w1": 0.1 * jax.random.normal(kG, (zdim, 256)),
+         "w2": 0.1 * jax.random.normal(kG, (256, img))}
+    D = {"w1": 0.1 * jax.random.normal(kD, (img, 256)),
+         "w2": 0.1 * jax.random.normal(kD, (256, 1))}
+    g_opt = FusedAdam(lr=2e-4)
+    d_opt = FusedAdam(lr=2e-4)
+    g_state, d_state = g_opt.init(G), d_opt.init(D)
+    amp_state = mp.init()
+    real = jax.random.normal(jax.random.PRNGKey(5), (gb, img))
+
+    def gen(Gp, z):
+        h_ = jnp.tanh(z @ Gp["w1"].astype(z.dtype))
+        return jnp.tanh(h_ @ Gp["w2"].astype(h_.dtype))
+
+    def disc(Dp, x_):
+        h_ = jnp.tanh(x_ @ Dp["w1"].astype(x_.dtype))
+        return h_ @ Dp["w2"].astype(h_.dtype)
+
+    bce = lambda logit, y: jnp.mean(
+        jnp.maximum(logit, 0) - logit * y
+        + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    )
+
+    @jax.jit
+    def gan_step(G, D, g_state, d_state, amp_state, z, real):
+        low = jnp.float16
+        # D step: two separately-scaled losses (real, fake), like the
+        # reference's errD_real/errD_fake with per-loss scalers
+        def d_loss_real(Dp):
+            l = bce(disc(Dp, real.astype(low)).astype(jnp.float32), 1.0)
+            return mp.scale_loss(amp_state, l, loss_id=0), l
+
+        def d_loss_fake(Dp):
+            fake = gen(jax.tree.map(lambda w: w.astype(low), G),
+                       z.astype(low))
+            l = bce(disc(Dp, fake).astype(jnp.float32), 0.0)
+            return mp.scale_loss(amp_state, l, loss_id=1), l
+
+        gr, lr_ = jax.grad(d_loss_real, has_aux=True)(D)
+        gr, f0, amp_state = mp.unscale_and_adjust(amp_state, gr, loss_id=0)
+        gf, lf_ = jax.grad(d_loss_fake, has_aux=True)(D)
+        gf, f1, amp_state = mp.unscale_and_adjust(amp_state, gf, loss_id=1)
+        d_grads = jax.tree.map(lambda a, b: a + b, gr, gf)
+        D, d_state = d_opt.step(d_state, d_grads, D,
+                                grads_finite=f0 & f1)
+
+        # G step: third scaler
+        def g_loss(Gp):
+            fake = gen(jax.tree.map(lambda w: w.astype(low), Gp),
+                       z.astype(low))
+            l = bce(disc(jax.tree.map(lambda w: w.astype(low), D),
+                         fake).astype(jnp.float32), 1.0)
+            return mp.scale_loss(amp_state, l, loss_id=2), l
+
+        gg, lg_ = jax.grad(g_loss, has_aux=True)(G)
+        gg, f2, amp_state = mp.unscale_and_adjust(amp_state, gg, loss_id=2)
+        G, g_state = g_opt.step(g_state, gg, G, grads_finite=f2)
+        return G, D, g_state, d_state, amp_state, lr_ + lf_, lg_
+
+    z = jax.random.normal(kz, (gb, zdim))
+    for _ in range(2):
+        G, D, g_state, d_state, amp_state, dl, gl = gan_step(
+            G, D, g_state, d_state, amp_state, z, real
+        )
+    jax.device_get((dl, gl))
+    gan_steps = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(gan_steps):
+        G, D, g_state, d_state, amp_state, dl, gl = gan_step(
+            G, D, g_state, d_state, amp_state, z, real
+        )
+    dl, gl = jax.device_get((dl, gl))
+    dt = time.perf_counter() - t0
+    out["dcgan_multi_scaler"] = {
+        "ms_per_step": round(dt / gan_steps * 1e3, 3),
+        "d_loss": round(float(dl), 4),
+        "g_loss": round(float(gl), 4),
+        "finite": bool(jnp.isfinite(dl)) and bool(jnp.isfinite(gl)),
+        "spec": {"steps": gan_steps, "warmup": 2, "batch": gb,
+                 "opt_level": "O1 (fp16 + 3 dynamic per-loss scalers)"},
+    }
+    log(f"dcgan: {out['dcgan_multi_scaler']}")
     print(json.dumps(out))
 
 
