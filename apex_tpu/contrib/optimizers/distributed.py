@@ -102,9 +102,18 @@ class _DistributedOptimizer:
     only — no parameter bytes ever cross DCN.
     """
 
-    def __init__(self, lr: float, axis_name: Any = DATA_PARALLEL_AXIS):
+    def __init__(self, lr: float, axis_name: Any = DATA_PARALLEL_AXIS,
+                 compressed_allgather: Optional[str] = None):
+        if compressed_allgather not in (None, "bf16", "e5m2"):
+            raise ValueError(
+                "compressed_allgather must be None, 'bf16' or 'e5m2'"
+            )
         self.lr = lr
         self.axis_name = axis_name
+        # opt-in lossy compression of the parameter all-gather payload
+        # (reference: distributed_fused_adam.py e5m2 compressed allgather):
+        # masters stay fp32; only the gathered bytes shrink 2x/4x
+        self.compressed_allgather = compressed_allgather
 
     @property
     def _hierarchical(self) -> bool:
@@ -204,8 +213,15 @@ class _DistributedOptimizer:
             new_state = tree_where(grads_finite, new_state, state)
             new_master = new_state["master"]
 
+        send = new_master
+        if self.compressed_allgather == "bf16":
+            send = send.astype(jnp.bfloat16)
+        elif self.compressed_allgather == "e5m2":
+            send = send.astype(jnp.float8_e5m2)
+        # unflatten casts each leaf to its model dtype, so no
+        # intermediate fp32 expansion of the gathered buffer is needed
         flat_params = all_gather_invariant(
-            new_master, self._shard_axis, axis=0, tiled=True
+            send, self._shard_axis, axis=0, tiled=True
         )
         new_params = meta.unflatten(flat_params)
         return new_params, new_state
@@ -223,9 +239,11 @@ class DistributedFusedAdam(_DistributedOptimizer):
         eps: float = 1e-8,
         adam_w_mode: bool = True,
         weight_decay: float = 0.0,
-        axis_name: str = DATA_PARALLEL_AXIS,
+        axis_name: Any = DATA_PARALLEL_AXIS,
+        compressed_allgather: Optional[str] = None,
     ):
-        super().__init__(lr=lr, axis_name=axis_name)
+        super().__init__(lr=lr, axis_name=axis_name,
+                         compressed_allgather=compressed_allgather)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -268,9 +286,11 @@ class DistributedFusedLAMB(_DistributedOptimizer):
         grad_averaging: bool = True,
         max_grad_norm: float = 1.0,
         use_nvlamb: bool = False,
-        axis_name: str = DATA_PARALLEL_AXIS,
+        axis_name: Any = DATA_PARALLEL_AXIS,
+        compressed_allgather: Optional[str] = None,
     ):
-        super().__init__(lr=lr, axis_name=axis_name)
+        super().__init__(lr=lr, axis_name=axis_name,
+                         compressed_allgather=compressed_allgather)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps

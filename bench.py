@@ -188,9 +188,15 @@ def child_gpt(platform: str):
             tps, n_params = run(fast=True, batch=b)
         except AssertionError:
             raise  # non-finite loss is a correctness failure, never OOM
-        except Exception as e:  # HBM OOM at the largest batches
+        except Exception as e:
+            msg = str(e)
+            oom = any(t in msg.upper() for t in
+                      ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "OOM",
+                       "ALLOCAT"))
+            if not oom or fast == 0.0:
+                raise  # only HBM exhaustion ends the sweep quietly
             last_err = e
-            log(f"fast b={b} failed ({str(e)[:120]}); keeping best so far")
+            log(f"fast b={b} OOM ({msg[:120]}); keeping best so far")
             break
         if b == BATCH:
             fast_matched = tps
