@@ -9,6 +9,7 @@ flagship benchmark drivers).
 from apex_tpu.models.bert import BertConfig, BertModel
 from apex_tpu.models.gpt import GPTConfig, GPTModel
 from apex_tpu.models.resnet import ResNet, ResNetConfig, resnet50
+from apex_tpu.models.t5 import T5Config, T5Model
 
 __all__ = [
     "GPTConfig",
@@ -18,4 +19,6 @@ __all__ = [
     "ResNet",
     "ResNetConfig",
     "resnet50",
+    "T5Config",
+    "T5Model",
 ]

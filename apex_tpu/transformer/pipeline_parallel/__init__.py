@@ -33,10 +33,12 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     forward_backward_pipelining_without_interleaving,
     get_forward_backward_func,
     pipeline,
+    pipeline_encdec,
 )
 
 __all__ = [
     "pipeline",
+    "pipeline_encdec",
     "pipeline_stage_specs",
     "sync_replicated_grads",
     "forward_backward_no_pipelining",

@@ -43,6 +43,7 @@ from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
 
 __all__ = [
     "pipeline",
+    "pipeline_encdec",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
@@ -181,6 +182,111 @@ def pipeline(
 
     (_, stash), _ = lax.scan(
         tick, (zeros_state, stash0), jnp.arange(ticks)
+    )
+    return _head_pass(last_fn, stash, microbatches, stage == pp - 1,
+                      axis_name)
+
+
+def pipeline_encdec(
+    enc_entry_fn: Callable[[Any], Any],
+    enc_stage_fn: Callable[[Any], Any],
+    dec_entry_fn: Callable[[Any], Any],
+    dec_stage_fn: Callable[[Any, Any], Any],
+    last_fn: Callable[[Any, Any], jnp.ndarray],
+    microbatches: Any,
+    split_stage: int,
+    *,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Encoder-and-decoder pipeline (reference: ModelType.encoder_and_decoder
+    scheduling in apex/transformer/pipeline_parallel/schedules/common.py:18-108
+    with ``pipeline_model_parallel_split_rank``).
+
+    Stages ``[0, split_stage)`` run the encoder, ``[split_stage, pp)`` the
+    decoder.  Three streams ride the ``ppermute`` ring together:
+
+    - ``xe``: the encoder activation — entered by ``enc_entry_fn`` at
+      stage 0, transformed by ``enc_stage_fn`` on encoder stages, passed
+      through on decoder stages;
+    - ``mem``: the finished encoder output (cross-attention memory) —
+      captured from the incoming ``xe`` at ``split_stage`` and carried
+      alongside its microbatch through the decoder stages;
+    - ``xd``: the decoder activation — entered by ``dec_entry_fn`` at
+      ``split_stage``, transformed by ``dec_stage_fn(xd, mem)``.
+
+    SPMD note: every stage executes both ``enc_stage_fn`` and
+    ``dec_stage_fn`` each tick and keeps its own branch (single compiled
+    program; lax.cond on a mesh-varying predicate lowers to select
+    anyway).  Encoder stages therefore burn the decoder stage's FLOPs
+    and vice versa — the cost of the reference's heterogeneous
+    per-process schedule becoming one compiled SPMD program.  pp and the
+    per-stage layer count are small where this matters (the reference's
+    own enc-dec splits are 2-4 stages per side).
+
+    Microbatch ``m`` exits at stage pp-1 at tick ``m + pp - 1`` exactly
+    as in :func:`pipeline`; the LM head (``last_fn``) runs once per
+    microbatch after the ring scan.  Differentiate through the result
+    for the reverse pipeline.
+    """
+    pp = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    if not (1 <= split_stage < pp):
+        raise ValueError(
+            f"split_stage ({split_stage}) must be in [1, pp) — at least "
+            f"one encoder and one decoder stage (pp={pp})"
+        )
+    num_micro = jax.tree.leaves(microbatches)[0].shape[0]
+    ticks = num_micro + pp - 1
+
+    mb0 = _index_microbatch(microbatches, 0)
+    zeros_xe = _ensure_varying(
+        jax.tree.map(lambda a: a * 0, enc_entry_fn(mb0)), axis_name
+    )
+    zeros_xd = _ensure_varying(
+        jax.tree.map(lambda a: a * 0, dec_entry_fn(mb0)), axis_name
+    )
+    zeros_mem = zeros_xe
+
+    enc_body = jax.checkpoint(enc_stage_fn) if remat else enc_stage_fn
+    dec_body = jax.checkpoint(dec_stage_fn) if remat else dec_stage_fn
+
+    stash0 = _make_stash(zeros_xd, num_micro)
+
+    def tick(carry, t):
+        xe, xd, mem, stash = carry
+        # encoder stream: fresh microbatch enters at stage 0
+        mb_enc = _index_microbatch(
+            microbatches, jnp.minimum(t, num_micro - 1)
+        )
+        xe_in = _where_tree(stage == 0, enc_entry_fn(mb_enc), xe)
+        # the microbatch arriving at the split stage this tick entered
+        # the ring split_stage ticks ago
+        dec_mb_idx = jnp.clip(t - split_stage, 0, num_micro - 1)
+        mb_dec = _index_microbatch(microbatches, dec_mb_idx)
+        at_split = stage == split_stage
+        # capture the finished encoder output as this microbatch's
+        # cross-attention memory and admit its decoder embedding
+        mem = _where_tree(at_split, xe, mem)
+        xd_in = _where_tree(at_split, dec_entry_fn(mb_dec), xd)
+
+        ye = enc_body(xe_in)
+        yd = dec_body(xd_in, mem)
+        is_enc = stage < split_stage
+        ye = _where_tree(is_enc, ye, xe_in)
+        yd = _where_tree(is_enc, xd_in, yd)
+
+        out_idx = jnp.maximum(t - (pp - 1), 0)
+        take = (stage == pp - 1) & (t >= pp - 1)
+        stash = _stash_add(stash, yd, out_idx, take)
+
+        xe = send_forward(ye, axis_name)
+        xd = send_forward(yd, axis_name)
+        mem = send_forward(mem, axis_name)
+        return (xe, xd, mem, stash), None
+
+    (_, _, _, stash), _ = lax.scan(
+        tick, (zeros_xe, zeros_xd, zeros_mem, stash0), jnp.arange(ticks)
     )
     return _head_pass(last_fn, stash, microbatches, stage == pp - 1,
                       axis_name)
