@@ -73,6 +73,8 @@ class T5Config:
     # an amp.Policy drives the dtypes, as in GPTConfig/BertConfig
     policy: Optional[Any] = None
     remat: bool = True
+    # same measured default as GPTConfig (PROFILE_r03.md exp 1)
+    remat_policy: Optional[str] = "dots_with_no_batch_dims_saveable"
     attention_impl: Optional[str] = None
 
     def __post_init__(self):
@@ -302,7 +304,11 @@ class T5Model:
 
     def _scan_layers(self, layers, x, body):
         if self.config.remat:
-            body = jax.checkpoint(body)
+            from apex_tpu.transformer.tensor_parallel.random import (
+                checkpoint,
+            )
+
+            body = checkpoint(body, policy=self.config.remat_policy)
 
         def step(h, lp):
             return body(lp, h), None
@@ -458,7 +464,11 @@ class T5Model:
                 params["enc_final_ln"]["bias"],
                 (c.hidden_size,), eps=c.layernorm_epsilon,
             ).astype(out.dtype)
-            is_last_enc = jax.lax.axis_index("pp") == split - 1
+            from apex_tpu.transformer.parallel_state import (
+                PIPELINE_PARALLEL_AXIS,
+            )
+
+            is_last_enc = jax.lax.axis_index(PIPELINE_PARALLEL_AXIS) == split - 1
             return jnp.where(is_last_enc, normed, out)
 
         def dec_stage(x, memory):

@@ -27,6 +27,12 @@ from typing import Any, Callable, Dict, Optional
 import jax
 
 from apex_tpu.pyprof.parse import op_table, parse  # noqa: E402,F401
+from apex_tpu.pyprof.prof import (  # noqa: E402,F401
+    OP_CLASSES,
+    classify,
+    prof,
+    prof_table,
+)
 
 __all__ = [
     "annotate",
@@ -34,6 +40,10 @@ __all__ = [
     "trace",
     "parse",
     "op_table",
+    "classify",
+    "prof",
+    "prof_table",
+    "OP_CLASSES",
     "cost_analysis",
     "summarize",
     "Timers",

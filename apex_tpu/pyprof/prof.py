@@ -1,0 +1,149 @@
+"""Per-op-class report over a parsed trace — the reference's ``prof`` stage.
+
+The reference maps every captured kernel to one of 27 op-class modules
+that know its semantics (reference: apex/pyprof/prof/ — blas.py, conv.py,
+optim.py, reduction.py, ...) and prints a per-op table with FLOPs/bytes.
+On TPU the kernel namespace is XLA's HLO opcode set (plus Pallas
+custom-calls), so the classifier keys on HLO names instead of CUDA kernel
+mangles; class semantics (whether a class does MXU work, moves bytes, or
+is a collective) drive the utilization columns.
+
+Typical use::
+
+    with pyprof.trace(log_dir):
+        step(...)
+    rows = pyprof.parse(log_dir, plane_filter="TPU")
+    classes = pyprof.prof(rows)
+    print(pyprof.prof_table(classes))
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+__all__ = ["classify", "prof", "prof_table", "OP_CLASSES"]
+
+
+# Each entry: (class name, regex over the normalized op name, kind).
+# kind ∈ {"compute", "memory", "collective", "host", "other"} — the
+# TPU-roofline role the class plays (MXU FLOPs / HBM bytes / ICI).
+# Order matters: first match wins.  The taxonomy mirrors the reference's
+# op-class split (reference: apex/pyprof/prof/ 27 modules) collapsed onto
+# the HLO opcode set.
+OP_CLASSES = (
+    ("flash_attention", r"flash|attention", "compute"),
+    ("pallas_kernel", r"pallas|custom-call|custom_call|mosaic", "compute"),
+    ("gemm", r"\bdot|gemm|matmul|einsum", "compute"),
+    ("convolution", r"conv(?!ert)", "compute"),
+    ("cholesky_triangular", r"cholesky|triangular", "compute"),
+    ("all_reduce", r"all-reduce|all_reduce|psum", "collective"),
+    ("all_gather", r"all-gather|all_gather", "collective"),
+    ("reduce_scatter", r"reduce-scatter|reduce_scatter", "collective"),
+    ("all_to_all", r"all-to-all|all_to_all", "collective"),
+    ("permute", r"collective-permute|ppermute|collective_permute",
+     "collective"),
+    ("host_transfer", r"infeed|outfeed|host|transfer|\bsend\b|\brecv\b",
+     "host"),
+    ("loop_control", r"\bwhile\b|conditional|checkpoint|remat|closed_call",
+     "compute"),
+    ("sort", r"sort|top-k|topk", "compute"),
+    ("rng", r"\brng\b|threefry|random|philox", "compute"),
+    ("scatter_gather", r"scatter|gather", "memory"),
+    ("slice_update", r"dynamic-slice|dynamic_slice|dynamic-update|"
+     r"dynamic_update|slice|pad", "memory"),
+    ("reduction", r"reduce|cumsum|cumulative", "compute"),
+    ("normalization", r"norm|batch-norm|batch_norm", "compute"),
+    ("copy_layout", r"copy|transpose|reshape|bitcast|broadcast|concat|"
+     r"reverse|tuple|convert", "memory"),
+    ("select_compare", r"select|compare|clamp|where|iota", "memory"),
+    ("elementwise", r"add|sub|mul|div|exp|log|tanh|sqrt|rsqrt|pow|neg|abs|"
+     r"max|min|and|or|xor|not|sin|cos|floor|ceil|sign|remainder", "memory"),
+    ("fusion", r"fusion|\bcall\b", "compute"),
+)
+
+# an HLO trace event name is often the full instruction text
+# ("%copy-start.5 = (bf16[8,8,1024,128]{...} ...") — the opcode is the
+# LHS symbol, so classification must never look past " = "
+_NORM = re.compile(r"^%?([a-zA-Z0-9_.\-]+?)(\.\d+)?$")
+
+
+def classify(name: str) -> tuple:
+    """→ (op_class, kind) for one HLO/kernel event name."""
+    base = name.strip().split(" = ", 1)[0].strip()
+    m = _NORM.match(base)
+    base = (m.group(1) if m else base).lower()
+    for cls, pat, kind in OP_CLASSES:
+        if re.search(pat, base):
+            return cls, kind
+    return "other", "other"
+
+
+#: trace lines that carry whole-program / per-step envelope events — a
+#: per-op report must not double-count them against the op rows
+_ENVELOPE_LINES = ("module", "step")
+
+
+def prof(
+    rows: List[Dict[str, Any]], include_envelopes: bool = False
+) -> List[Dict[str, Any]]:
+    """Aggregate :func:`apex_tpu.pyprof.parse` rows into per-class rows.
+
+    Returns rows sorted by total time::
+
+        {"op_class", "kind", "count", "ops", "total_ms", "avg_ms", "pct"}
+
+    ``ops`` is the distinct member-op names (up to 8, by time), the
+    breadcrumb back to the per-op table.  Rows from "XLA Modules" /
+    "Steps" trace lines (whole-program envelopes that would double-count
+    every op) are dropped unless ``include_envelopes``.
+    """
+    agg: Dict[str, Dict[str, Any]] = {}
+    for r in rows:
+        line = str(r.get("line", "")).lower()
+        if not include_envelopes and any(
+            e in line for e in _ENVELOPE_LINES
+        ):
+            continue
+        cls, kind = classify(r["name"])
+        row = agg.setdefault(cls, {
+            "op_class": cls, "kind": kind, "count": 0, "total_ms": 0.0,
+            "_members": {},
+        })
+        row["count"] += r["count"]
+        row["total_ms"] += r["total_ms"]
+        row["_members"][r["name"]] = (
+            row["_members"].get(r["name"], 0.0) + r["total_ms"]
+        )
+    out = sorted(agg.values(), key=lambda r: -r["total_ms"])
+    total = sum(r["total_ms"] for r in out) or 1.0
+    for r in out:
+        members = sorted(r.pop("_members").items(), key=lambda kv: -kv[1])
+        r["ops"] = [k for k, _ in members[:8]]
+        r["avg_ms"] = r["total_ms"] / max(r["count"], 1)
+        r["pct"] = 100.0 * r["total_ms"] / total
+    return out
+
+
+def prof_table(classes: List[Dict[str, Any]], top: Optional[int] = None) -> str:
+    """Format prof() rows — the reference's per-op-class summary print."""
+    lines = [
+        f"{'class':<20} {'kind':<11} {'count':>7} {'total ms':>10} "
+        f"{'%':>6}  top ops"
+    ]
+    for r in classes[:top]:
+        ops = ", ".join(r["ops"][:3])
+        lines.append(
+            f"{r['op_class']:<20} {r['kind']:<11} {r['count']:>7} "
+            f"{r['total_ms']:>10.3f} {r['pct']:>6.1f}  {ops[:60]}"
+        )
+    by_kind: Dict[str, float] = {}
+    for r in classes:
+        by_kind[r["kind"]] = by_kind.get(r["kind"], 0.0) + r["total_ms"]
+    total = sum(by_kind.values()) or 1.0
+    split = "  ".join(
+        f"{k}: {100.0 * v / total:.1f}%" for k, v in
+        sorted(by_kind.items(), key=lambda kv: -kv[1])
+    )
+    lines.append(f"-- time by kind: {split}")
+    return "\n".join(lines)

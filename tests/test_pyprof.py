@@ -105,3 +105,51 @@ def test_parse_missing_dir_raises(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         parse(str(tmp_path / "nope"))
+
+
+def test_classify_op_classes():
+    """HLO names land in the reference-taxonomy op classes
+    (reference: apex/pyprof/prof/ 27 op-class modules)."""
+    from apex_tpu.pyprof import classify
+
+    assert classify("%dot.12") == ("gemm", "compute")
+    assert classify("fusion.3")[0] == "fusion"
+    assert classify("while.2")[0] == "loop_control"
+    assert classify("%copy-start.5 = (bf16[8,8,1024,128]{3,2,1,0}, u32[]{})")[0] == "copy_layout"
+    assert classify("convert.9")[0] == "copy_layout"
+    assert classify("all-reduce.1") == ("all_reduce", "collective")
+    assert classify("collective-permute.7")[1] == "collective"
+    assert classify("copy.2") == ("copy_layout", "memory")
+    assert classify("convolution.4")[0] == "convolution"
+    assert classify("flash_attention_fwd")[0] == "flash_attention"
+    assert classify("threefry2x32")[0] == "rng"
+    assert classify("mystery_kernel_xyz") == ("other", "other")
+
+
+def test_prof_class_report(tmp_path):
+    """parse → prof → per-class table with time-by-kind split
+    (reference: python -m apex.pyprof.prof)."""
+    from apex_tpu.pyprof import parse, prof, prof_table, trace
+
+    @jax.jit
+    def step(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    x = jnp.ones((128, 128))
+    w = jnp.ones((128, 128))
+    jax.block_until_ready(step(x, w))
+    log_dir = str(tmp_path / "trace")
+    with trace(log_dir):
+        for _ in range(3):
+            jax.block_until_ready(step(x, w))
+
+    classes = prof(parse(log_dir))
+    assert classes, "prof returned no classes"
+    by_name = {r["op_class"]: r for r in classes}
+    # a matmul step must produce gemm (or fused) compute time
+    assert "gemm" in by_name or "fusion" in by_name
+    for r in classes:
+        assert r["count"] >= 1 and r["total_ms"] >= 0 and r["ops"]
+    assert abs(sum(r["pct"] for r in classes) - 100.0) < 1e-6
+    table = prof_table(classes)
+    assert "time by kind" in table and "class" in table

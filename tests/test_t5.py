@@ -185,7 +185,8 @@ def test_t5_policy_driven():
     from apex_tpu.amp import get_policy
 
     cfg = small_config(policy=get_policy("O5"))
-    assert cfg.params_dtype == jnp.float32 or cfg.params_dtype == jnp.bfloat16
+    assert cfg.params_dtype == get_policy("O5").param_dtype
+    assert cfg.compute_dtype == get_policy("O5").compute_dtype
     mesh = parallel_state.initialize_model_parallel()
     try:
         model = T5Model(cfg)
