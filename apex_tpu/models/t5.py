@@ -45,6 +45,7 @@ from apex_tpu.ops.attention import flash_attention
 from apex_tpu.ops.layer_norm import fused_layer_norm_affine
 from apex_tpu.transformer.parallel_state import (
     DATA_PARALLEL_AXIS,
+    PIPELINE_PARALLEL_AXIS,
     TENSOR_PARALLEL_AXIS,
 )
 from apex_tpu.transformer.tensor_parallel import (
@@ -464,10 +465,6 @@ class T5Model:
                 params["enc_final_ln"]["bias"],
                 (c.hidden_size,), eps=c.layernorm_epsilon,
             ).astype(out.dtype)
-            from apex_tpu.transformer.parallel_state import (
-                PIPELINE_PARALLEL_AXIS,
-            )
-
             is_last_enc = jax.lax.axis_index(PIPELINE_PARALLEL_AXIS) == split - 1
             return jnp.where(is_last_enc, normed, out)
 

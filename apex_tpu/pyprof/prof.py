@@ -80,8 +80,10 @@ def classify(name: str) -> tuple:
 
 
 #: trace lines that carry whole-program / per-step envelope events — a
-#: per-op report must not double-count them against the op rows
-_ENVELOPE_LINES = ("module", "step")
+#: per-op report must not double-count them against the op rows.
+#: Exact (lowercased) XLA line names; substring matching would silently
+#: drop user lines that merely contain "step"
+_ENVELOPE_LINES = ("xla modules", "steps", "framework name scope")
 
 
 def prof(
@@ -101,9 +103,7 @@ def prof(
     agg: Dict[str, Dict[str, Any]] = {}
     for r in rows:
         line = str(r.get("line", "")).lower()
-        if not include_envelopes and any(
-            e in line for e in _ENVELOPE_LINES
-        ):
+        if not include_envelopes and line in _ENVELOPE_LINES:
             continue
         cls, kind = classify(r["name"])
         row = agg.setdefault(cls, {

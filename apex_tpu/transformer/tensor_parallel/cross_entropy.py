@@ -11,13 +11,19 @@ as a constant.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
 from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
 
-__all__ = ["vocab_parallel_cross_entropy"]
+__all__ = [
+    "vocab_parallel_cross_entropy",
+    "vocab_parallel_cross_entropy_from_hidden",
+]
 
 
 def vocab_parallel_cross_entropy(
@@ -57,3 +63,199 @@ def vocab_parallel_cross_entropy(
     target_logit = jax.lax.psum(picked, axis_name)
 
     return jnp.log(sum_exp) - target_logit
+
+
+# ---------------------------------------------------------------------------
+# fused CE from hidden states (logits never materialized)
+# ---------------------------------------------------------------------------
+
+
+def _varying_like(arr, axis_name, *refs):
+    """Mark ``arr`` varying over ``axis_name`` plus every mesh axis any of
+    ``refs`` varies over — scan carries must enter with exactly the vma
+    the body's output has (e.g. dp-varying hidden × tp-varying weight
+    makes the running statistics (dp, tp)-varying)."""
+    need = {axis_name}
+    for r in refs:
+        try:
+            need |= set(jax.typeof(r).vma)
+        except AttributeError:  # not an array type / no vma (outside shard_map)
+            pass
+    try:
+        have = set(jax.typeof(arr).vma)
+    except AttributeError:
+        have = set()
+    for ax in sorted(need - have):
+        arr = lax.pcast(arr, ax, to="varying")
+    return arr
+
+
+def _vocab_range(weight, axis_name):
+    world = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    return VocabUtility.vocab_range_from_per_partition_vocab_size(
+        weight.shape[0], rank, world
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ce_from_hidden(x, weight, target, axis_name, chunk):
+    loss, _ = _ce_fwd_scan(x, weight, target, axis_name, chunk)
+    return loss
+
+
+def _ce_fwd_scan(x, weight, target, axis_name, chunk):
+    """Online log-sum-exp over vocab chunks; returns (loss, residuals)."""
+    n = x.shape[0]
+    num_chunks = weight.shape[0] // chunk
+    start, end = _vocab_range(weight, axis_name)
+    in_range = (target >= start) & (target < end)
+    local_target = jnp.where(in_range, target - start, 0)
+
+    def body(carry, c):
+        m, se, tl = carry
+        w_c = lax.dynamic_slice_in_dim(weight, c * chunk, chunk, axis=0)
+        logits_c = jnp.einsum(
+            "nh,vh->nv", x, w_c.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        m_c = jnp.max(logits_c, axis=-1)
+        m_new = jnp.maximum(m, m_c)
+        se = se * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits_c - m_new[:, None]), axis=-1
+        )
+        idx = local_target - c * chunk
+        in_chunk = (idx >= 0) & (idx < chunk)
+        picked = jnp.take_along_axis(
+            logits_c, jnp.clip(idx, 0, chunk - 1)[:, None], axis=-1
+        )[:, 0]
+        tl = jnp.where(in_chunk, picked, tl)
+        return (m_new, se, tl), None
+
+    init = jax.tree.map(
+        lambda a: _varying_like(a, axis_name, x, weight, target),
+        (
+            jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+        ),
+    )
+    (m, se, tl), _ = lax.scan(body, init, jnp.arange(num_chunks))
+
+    # identical 3-collective math to vocab_parallel_cross_entropy: the
+    # max is a stop-gradient constant, sum-exp and the owning shard's
+    # target logit are psum'd
+    global_max = lax.pmax(lax.stop_gradient(m), axis_name)
+    sum_exp = lax.psum(se * jnp.exp(m - global_max), axis_name)
+    target_logit = lax.psum(
+        jnp.where(in_range, tl - global_max, 0.0), axis_name
+    )
+    loss = jnp.log(sum_exp) - target_logit
+    residuals = (x, weight, local_target, in_range, global_max, sum_exp)
+    return loss, residuals
+
+
+def _ce_fwd(x, weight, target, axis_name, chunk):
+    return _ce_fwd_scan(x, weight, target, axis_name, chunk)
+
+
+def _ce_bwd(axis_name, chunk, residuals, g):
+    """dlogits = softmax − one-hot, re-derived chunk-by-chunk (logits are
+    recomputed, never stored); dx accumulates across chunks, dW stacks."""
+    x, weight, local_target, in_range, global_max, sum_exp = residuals
+    num_chunks = weight.shape[0] // chunk
+    gf = g.astype(jnp.float32)
+
+    def body(dx, c):
+        w_c = lax.dynamic_slice_in_dim(weight, c * chunk, chunk, axis=0)
+        logits_c = jnp.einsum(
+            "nh,vh->nv", x, w_c.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        p_c = jnp.exp(logits_c - global_max[:, None]) / sum_exp[:, None]
+        idx = local_target - c * chunk
+        in_chunk = in_range & (idx >= 0) & (idx < chunk)
+        onehot = (
+            jax.nn.one_hot(jnp.clip(idx, 0, chunk - 1), chunk,
+                           dtype=jnp.float32)
+            * in_chunk[:, None]
+        )
+        dlogits = (p_c - onehot) * gf[:, None]
+        dx = dx + jnp.einsum(
+            "nv,vh->nh", dlogits.astype(x.dtype), w_c.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        dw_c = jnp.einsum(
+            "nv,nh->vh", dlogits.astype(x.dtype), x,
+            preferred_element_type=jnp.float32,
+        )
+        return dx, dw_c
+
+    dx, dw = lax.scan(
+        body,
+        _varying_like(jnp.zeros(x.shape, jnp.float32), axis_name,
+                      x, weight, g),
+        jnp.arange(num_chunks),
+    )
+    dw = dw.reshape(weight.shape).astype(weight.dtype)
+    # every vocab shard holds part of the softmax row: the hidden grad is
+    # the sum of the per-shard contributions (the two-step path gets this
+    # psum from the einsum transpose automatically)
+    dx = lax.psum(dx, axis_name)
+    # same story for the weight grad over the *other* mesh axes (e.g. a
+    # dp-varying hidden makes dw (dp, tp)-varying; the primal weight is
+    # tp-varying only, and the einsum transpose would psum over dp)
+    dx = _psum_down_to(dx, x)
+    dw = _psum_down_to(dw, weight)
+    return dx.astype(x.dtype), dw, None
+
+
+def _psum_down_to(val, primal):
+    """psum ``val`` over every mesh axis it varies over beyond the
+    primal's vma — custom_vjp cotangents must type-match their primals."""
+    try:
+        extra = set(jax.typeof(val).vma) - set(jax.typeof(primal).vma)
+    except AttributeError:
+        return val
+    for ax in sorted(extra):
+        val = lax.psum(val, ax)
+    return val
+
+
+_ce_from_hidden.defvjp(_ce_fwd, _ce_bwd)
+
+
+def vocab_parallel_cross_entropy_from_hidden(
+    hidden: jnp.ndarray,
+    weight: jnp.ndarray,
+    target: jnp.ndarray,
+    axis_name: str = TENSOR_PARALLEL_AXIS,
+    chunk: int = 4096,
+) -> jnp.ndarray:
+    """Fused LM-head + vocab-parallel CE: per-token loss straight from
+    hidden states and the (tied, vocab-sharded) embedding weight, with
+    the (..., vocab) logits **never materialized** in HBM.
+
+    The fp32 logits tensor the two-step path stores is (tokens × vocab) —
+    1 GB at b=8/s=1024/V=32k — and is pure bandwidth cost; here an online
+    log-sum-exp walks (vocab/tp)/chunk weight slices and the backward
+    re-derives each chunk's softmax from the saved (max, sum-exp) row
+    statistics, the same recompute-over-store trade as flash attention
+    (capability superset of the reference's fused xentropy kernel,
+    apex/contrib/csrc/xentropy/ + apex/transformer/tensor_parallel/
+    cross_entropy.py, which still materializes logits).
+
+    ``hidden``: (..., h); ``weight``: (vocab/tp, h); ``target``: (...)
+    global ids.  Returns (...) fp32 losses.  Falls back to the two-step
+    path when vocab/tp is not divisible by ``chunk``.
+    """
+    lead = hidden.shape[:-1]
+    h = hidden.shape[-1]
+    if weight.shape[0] % chunk:
+        logits = jnp.einsum(
+            "...h,vh->...v", hidden, weight.astype(hidden.dtype)
+        )
+        return vocab_parallel_cross_entropy(logits, target, axis_name)
+    x = hidden.reshape(-1, h)
+    t = target.reshape(-1)
+    return _ce_from_hidden(x, weight, t, axis_name, chunk).reshape(lead)
