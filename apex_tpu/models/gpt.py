@@ -533,3 +533,70 @@ class GPTModel:
         )
         loss = jnp.mean(per_micro)
         return jax.lax.pmean(loss, DATA_PARALLEL_AXIS)
+
+    def pipeline_1f1b_grads(
+        self,
+        params: Dict[str, Any],
+        tokens: jnp.ndarray,
+        targets: jnp.ndarray,
+        num_microbatches: int,
+    ) -> tuple:
+        """Fwd+bwd through the true 1F1B schedule: returns
+        ``(mean loss, grads)`` directly — in-flight activation memory is
+        bounded by the pipeline depth, not ``num_microbatches``
+        (PIPELINE_MEMORY.json: flat temp memory from 2 to 32
+        microbatches).  Prefer this over ``jax.grad(pipeline_loss)``
+        for deep gradient accumulation.  Same placement contract as
+        :meth:`pipeline_loss`; grads come back dp-shard-local with
+        shared-param sync already applied."""
+        from apex_tpu.transformer.pipeline_parallel import (
+            pipeline_1f1b,
+            sync_replicated_grads,
+        )
+
+        c = self.config
+        b, s = tokens.shape
+        if b % num_microbatches:
+            raise ValueError(
+                f"local batch ({b}) must be divisible by "
+                f"num_microbatches ({num_microbatches})"
+            )
+        mb = b // num_microbatches
+        mbs = {
+            "tokens": tokens.reshape(num_microbatches, mb, s),
+            "targets": targets.reshape(num_microbatches, mb, s),
+        }
+
+        def first_fn(prm, m):
+            x = self.embedding.apply(prm["embedding"], m["tokens"])
+            x = x + prm["pos_embedding"][:s][None, :, :].astype(x.dtype)
+            return x.astype(c.compute_dtype)
+
+        def stage_fn(prm, x):
+            def body(h, lp):
+                out, _aux = self._layer(lp, h, None)
+                return out, None
+
+            out, _ = jax.lax.scan(body, x, prm["layers"])
+            return out
+
+        def last_fn(prm, x, m):
+            x = fused_layer_norm_affine(
+                x.astype(jnp.float32),
+                prm["final_ln"]["scale"],
+                prm["final_ln"]["bias"],
+                (c.hidden_size,),
+                eps=c.layernorm_epsilon,
+            ).astype(c.compute_dtype)
+            per_token = self._per_token_ce(prm, x, m["targets"])
+            return jnp.mean(per_token)
+
+        losses, grads = pipeline_1f1b(
+            first_fn, stage_fn, last_fn, params, mbs
+        )
+        grads = sync_replicated_grads(grads, self.pipeline_param_specs())
+        loss = jax.lax.pmean(jnp.mean(losses), DATA_PARALLEL_AXIS)
+        grads = jax.tree.map(
+            lambda g: jax.lax.pmean(g, DATA_PARALLEL_AXIS), grads
+        )
+        return loss, grads

@@ -33,11 +33,13 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     forward_backward_pipelining_without_interleaving,
     get_forward_backward_func,
     pipeline,
+    pipeline_1f1b,
     pipeline_encdec,
 )
 
 __all__ = [
     "pipeline",
+    "pipeline_1f1b",
     "pipeline_encdec",
     "pipeline_stage_specs",
     "sync_replicated_grads",

@@ -39,10 +39,12 @@ from jax import lax
 from apex_tpu.transformer.parallel_state import PIPELINE_PARALLEL_AXIS
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
     send_forward,
+    send_forward_recv_backward,
 )
 
 __all__ = [
     "pipeline",
+    "pipeline_1f1b",
     "pipeline_encdec",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
@@ -63,6 +65,33 @@ def _ensure_varying(tree: Any, axis_name: str) -> Any:
         except Exception:
             pass
         return lax.pcast(x, axis_name, to="varying")
+
+    return jax.tree.map(cast, tree)
+
+
+def _vma_union(*trees) -> set:
+    """Union of the varying-manual-axes of every leaf of every tree."""
+    axes: set = set()
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            try:
+                axes |= set(jax.typeof(leaf).vma)
+            except AttributeError:
+                pass
+    return axes
+
+
+def _cast_varying(tree: Any, axes: set) -> Any:
+    """pcast every leaf to be varying over all of ``axes``."""
+
+    def cast(x):
+        try:
+            have = set(jax.typeof(x).vma)
+        except AttributeError:
+            have = set()
+        for ax in sorted(axes - have):
+            x = lax.pcast(x, ax, to="varying")
+        return x
 
     return jax.tree.map(cast, tree)
 
@@ -185,6 +214,169 @@ def pipeline(
     )
     return _head_pass(last_fn, stash, microbatches, stage == pp - 1,
                       axis_name)
+
+
+def pipeline_1f1b(
+    first_fn: Callable[[Any, Any], Any],
+    stage_fn: Callable[[Any, Any], Any],
+    last_fn: Callable[[Any, Any, Any], jnp.ndarray],
+    params: Any,
+    microbatches: Any,
+    *,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+) -> tuple:
+    """True 1F1B: forward and backward interleave inside ONE compiled
+    scan, and in-flight activation state is bounded by the pipeline
+    depth — not by the microbatch count
+    (reference: apex/transformer/pipeline_parallel/schedules/
+    fwd_bwd_pipelining_without_interleaving.py:112-149 steady state).
+
+    Unlike :func:`pipeline` (which is differentiated from outside and
+    therefore scans all ``num_micro`` microbatches' residuals into the
+    autodiff tape), this schedule IS the fwd+bwd: it returns the
+    per-microbatch losses and the gradient of their **mean** w.r.t.
+    ``params`` directly.  Memory: a circular buffer of ``2*pp`` saved
+    stage *inputs* per stage; each backward tick re-derives its stage
+    activations from the saved input (per-stage remat — recompute over
+    store, the standard TPU trade) and one ``jax.vjp`` pulls the
+    cotangent through.  Peak activation memory is O(pp), independent of
+    gradient-accumulation depth, which is the entire point of 1F1B.
+
+    Schedule coordinates (tick ``t``, stage ``p``, ``pp`` stages,
+    ``M`` microbatches, ``T = M + 2*pp - 2`` ticks):
+
+    - forward of microbatch ``t - p`` (when in range);
+    - backward of microbatch ``t - (2*pp - 2 - p)`` — the last stage
+      runs a microbatch's backward in the SAME tick as its forward,
+      stage 0 runs it ``2*(pp-1)`` ticks later;
+    - activations ride ``ppermute`` +1, cotangents ride ``ppermute``
+      −1, both per tick (the reference's send_forward_recv_backward
+      pair, p2p_communication.py:183-404).
+
+    Functions take ``params`` explicitly (the schedule differentiates
+    through them): ``first_fn(params, mb) -> x``,
+    ``stage_fn(params, x) -> y``, ``last_fn(params, y, mb) -> scalar``.
+    ``params["..."]`` leaves that are stage-local must be sharded over
+    the pipeline axis by the caller exactly as for :func:`pipeline`;
+    apply ``sync_replicated_grads`` to the returned grads for shared
+    (replicated) params, as usual.
+
+    Returns ``(losses, grads)``: the (M,) per-microbatch losses
+    (replicated over the pipeline axis) and ``d(mean losses)/d params``.
+    """
+    pp = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    num_micro = jax.tree.leaves(microbatches)[0].shape[0]
+    ticks = num_micro + 2 * pp - 2
+    nbuf = 2 * pp
+
+    mb0 = _index_microbatch(microbatches, 0)
+    # mark the params varying over the data axes (dp/cp, whatever the
+    # microbatches vary over) and the pipeline axis: the vjps then
+    # return grads that are data-shard-local (the same contract as
+    # differentiating the GPipe pipeline from outside — the caller
+    # pmean's over "dp") and per-stage (sync_replicated_grads psums the
+    # shared ones, as usual).  Model axes like "tp" are deliberately NOT
+    # cast: the vjp transpose inserts the tp psums tp-replicated params
+    # need, exactly as plain autodiff would.
+    data_axes = _vma_union(microbatches)
+    params = _cast_varying(params, data_axes | {axis_name})
+    # carry vmas come from probes of the actual functions — cotangents
+    # type-match their primals, so grads0 = params*0 is exact, and the
+    # activation stream/cotangent/buffer all share the entry
+    # activation's vma (+ the pipeline axis the ppermutes introduce)
+    x_probe = first_fn(params, mb0)
+    zeros_x = _cast_varying(
+        jax.tree.map(lambda a: a * 0, x_probe), {axis_name}
+    )
+    # stage output cotangent carries the same structure as the stage
+    # input (homogeneous stages)
+    zeros_ct = zeros_x
+    buffer0 = _make_stash(zeros_x, nbuf)
+    grads0 = jax.tree.map(lambda p_: p_ * 0, params)
+    loss_probe = last_fn(
+        params, jax.tree.map(lambda a: a * 0, x_probe), mb0
+    )
+    losses0 = _cast_varying(
+        jnp.zeros((num_micro,), jnp.float32),
+        _vma_union(loss_probe) | {axis_name},
+    )
+    loss_seed = jnp.float32(1.0 / num_micro)
+
+    def tick(carry, t):
+        fwd_state, bwd_ct, buffer, grads, losses = carry
+
+        # ---- forward: microbatch t - p enters/advances ----------------
+        mf = t - stage
+        fwd_valid = (mf >= 0) & (mf < num_micro)
+        mb_f = _index_microbatch(
+            microbatches, jnp.clip(mf, 0, num_micro - 1)
+        )
+        x_in = _where_tree(stage == 0, first_fn(params, mb_f), fwd_state)
+        y = stage_fn(params, x_in)
+        slot_f = jnp.clip(mf, 0, num_micro - 1) % nbuf
+        buffer = jax.tree.map(
+            lambda b, xi: b.at[slot_f].set(
+                jnp.where(fwd_valid, xi, b[slot_f])
+            ),
+            buffer, x_in,
+        )
+
+        # ---- backward: microbatch t - (2pp - 2 - p) retires -----------
+        mb_idx = t - (2 * pp - 2 - stage)
+        bwd_valid = (mb_idx >= 0) & (mb_idx < num_micro)
+        mb_c = jnp.clip(mb_idx, 0, num_micro - 1)
+        mb_b = _index_microbatch(microbatches, mb_c)
+        slot_b = mb_c % nbuf
+        x_saved = jax.tree.map(lambda b: b[slot_b], buffer)
+
+        # re-derive this stage's activations from the saved input
+        # (per-stage remat) and pull the cotangent through
+        y_rec, stage_vjp = jax.vjp(stage_fn, params, x_saved)
+
+        # the exit stage seeds its own cotangent from the loss head —
+        # one head application per tick, so the head runs ~(T/M)·M ≈ M
+        # times total, not once per stage per tick
+        loss_m, head_vjp = jax.vjp(
+            lambda prm, yy: last_fn(prm, yy, mb_b), params, y_rec
+        )
+        is_exit = stage == pp - 1
+        head_seed = _cast_varying(
+            jnp.where(is_exit & bwd_valid, loss_seed, 0.0),
+            _vma_union(loss_m),
+        )
+        dparams_head, dy_head = head_vjp(head_seed)
+
+        dy = _where_tree(is_exit, dy_head, bwd_ct)
+        dy = _where_tree(bwd_valid, dy, jax.tree.map(jnp.zeros_like, dy))
+        dparams_stage, dx = stage_vjp(dy)
+
+        # pipeline-entry cotangent feeds the embedding (stage 0 only)
+        demb_ct = _where_tree(
+            stage == 0, dx, jax.tree.map(jnp.zeros_like, dx)
+        )
+        _, emb_vjp = jax.vjp(lambda prm: first_fn(prm, mb_b), params)
+        (dparams_emb,) = emb_vjp(demb_ct)
+
+        grads = jax.tree.map(
+            lambda g, a, b, c: g + a + b + c,
+            grads, dparams_stage, dparams_head, dparams_emb,
+        )
+        losses = losses.at[mb_c].add(
+            jnp.where(is_exit & bwd_valid, loss_m, 0.0)
+        )
+
+        fwd_state, bwd_ct = send_forward_recv_backward(y, dx, axis_name)
+        return (fwd_state, bwd_ct, buffer, grads, losses), None
+
+    (_, _, _, grads, losses), _ = lax.scan(
+        tick,
+        (zeros_x, zeros_ct, buffer0, grads0, losses0),
+        jnp.arange(ticks),
+    )
+    # only the exit stage accumulated real losses
+    losses = lax.psum(losses, axis_name)
+    return losses, grads
 
 
 def pipeline_encdec(

@@ -31,7 +31,9 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.pipeline_parallel import (
     pipeline,
+    pipeline_1f1b,
     pipeline_stage_specs,
+    sync_replicated_grads,
 )
 
 LAYERS_PER_STAGE = 2
@@ -41,39 +43,58 @@ MB_ROWS = 8
 VOCAB = 1024
 
 
+def _setup(num_micro: int):
+    """Model, specs, and data shared by both schedules' measurements —
+    one definition so the GPipe and 1F1B rows stay comparable."""
+    n_layers = PP * LAYERS_PER_STAGE
+    params = {
+        "w": jnp.zeros((n_layers, HIDDEN, HIDDEN)),
+        "b": jnp.zeros((n_layers, HIDDEN)),
+        "head": jnp.zeros((HIDDEN, VOCAB)),
+    }
+    specs = pipeline_stage_specs({"w": P(None, None, None),
+                                  "b": P(None, None)})
+    specs = {**specs, "head": P()}
+    x = jnp.zeros((num_micro, MB_ROWS, HIDDEN))
+    y = jnp.zeros((num_micro, MB_ROWS, HIDDEN))
+    return params, specs, x, y
+
+
+def _stage_body(local, h):
+    def body(c, lp):
+        return jnp.tanh(c @ lp["w"] + lp["b"]), None
+
+    out, _ = jax.lax.scan(body, h, local)
+    return out
+
+
+def _head_loss(head, h, mb):
+    return jnp.mean((h @ head)[..., :HIDDEN] * 0 + (h - mb["y"]) ** 2)
+
+
+def _memory_row(f, params, x, y, **tags):
+    mem = f.lower(params, x, y).compile().memory_analysis()
+    return {
+        **tags,
+        "temp_mb": round(mem.temp_size_in_bytes / 1e6, 3),
+        "argument_mb": round(mem.argument_size_in_bytes / 1e6, 3),
+        "output_mb": round(mem.output_size_in_bytes / 1e6, 3),
+    }
+
+
 def measure(num_micro: int, remat: bool) -> dict:
     mesh = parallel_state.initialize_model_parallel(
         pipeline_model_parallel_size_=PP
     )
     try:
-        n_layers = PP * LAYERS_PER_STAGE
-        params = {
-            "w": jnp.zeros((n_layers, HIDDEN, HIDDEN)),
-            "b": jnp.zeros((n_layers, HIDDEN)),
-            "head": jnp.zeros((HIDDEN, VOCAB)),
-        }
-        specs = pipeline_stage_specs({"w": P(None, None, None),
-                                      "b": P(None, None)})
-        specs = {**specs, "head": P()}
-        x = jnp.zeros((num_micro, MB_ROWS, HIDDEN))
-        y = jnp.zeros((num_micro, MB_ROWS, HIDDEN))
-
-        def stage(local, h):
-            def body(c, lp):
-                return jnp.tanh(c @ lp["w"] + lp["b"]), None
-
-            out, _ = jax.lax.scan(body, h, local)
-            return out
+        params, specs, x, y = _setup(num_micro)
 
         def loss(params, x, y):
-            head = params["head"]
             local = {"w": params["w"], "b": params["b"]}
             per = pipeline(
                 first_fn=lambda mb: mb["x"],
-                stage_fn=lambda h: stage(local, h),
-                last_fn=lambda h, mb: jnp.mean(
-                    (h @ head)[..., :HIDDEN] * 0 + (h - mb["y"]) ** 2
-                ),
+                stage_fn=lambda h: _stage_body(local, h),
+                last_fn=lambda h, mb: _head_loss(params["head"], h, mb),
                 microbatches={"x": x, "y": y},
                 remat=remat,
             )
@@ -83,14 +104,41 @@ def measure(num_micro: int, remat: bool) -> dict:
             jax.value_and_grad(loss), mesh=mesh,
             in_specs=(specs, P(), P()), out_specs=(P(), specs),
         ))
-        mem = f.lower(params, x, y).compile().memory_analysis()
-        return {
-            "num_micro": num_micro,
-            "remat": remat,
-            "temp_mb": round(mem.temp_size_in_bytes / 1e6, 3),
-            "argument_mb": round(mem.argument_size_in_bytes / 1e6, 3),
-            "output_mb": round(mem.output_size_in_bytes / 1e6, 3),
-        }
+        return _memory_row(f, params, x, y, schedule="gpipe",
+                           num_micro=num_micro, remat=remat)
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def measure_1f1b(num_micro: int) -> dict:
+    """True 1F1B: in-flight state bounded by 2*pp saved stage inputs —
+    temp memory must be ~flat in num_micro."""
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=PP
+    )
+    try:
+        params, specs, x, y = _setup(num_micro)
+
+        def fb(params, x, y):
+            losses, grads = pipeline_1f1b(
+                first_fn=lambda prm, mb: mb["x"],
+                stage_fn=lambda prm, h: _stage_body(
+                    {"w": prm["w"], "b": prm["b"]}, h
+                ),
+                last_fn=lambda prm, h, mb: _head_loss(prm["head"], h, mb),
+                params=params,
+                microbatches={"x": x, "y": y},
+            )
+            grads = sync_replicated_grads(grads, specs)
+            return jnp.mean(losses), grads
+
+        f = jax.jit(jax.shard_map(
+            fb, mesh=mesh, in_specs=(specs, P(), P()),
+            out_specs=(P(), specs),
+        ))
+        return _memory_row(f, params, x, y, schedule="1f1b",
+                           num_micro=num_micro,
+                           remat="per-stage (built in)")
     finally:
         parallel_state.destroy_model_parallel()
 
@@ -102,6 +150,10 @@ def main():
             row = measure(num_micro, remat)
             rows.append(row)
             print(json.dumps(row))
+    for num_micro in (2, 4, 8, 16, 32):
+        row = measure_1f1b(num_micro)
+        rows.append(row)
+        print(json.dumps(row))
     # scaling diagnosis: slope of temp vs num_micro, per remat mode
     doc = {
         "config": {
