@@ -49,6 +49,10 @@ class BertConfig:
     # an amp.Policy drives both dtypes (one-kwarg O0..O5 switch)
     policy: Optional[Any] = None
     remat: bool = True
+    # same measured defaults as GPTConfig (PROFILE_r03.md exps 1 and 5)
+    remat_policy: Optional[str] = "dots_with_no_batch_dims_saveable"
+    fused_ce: bool = True
+    fused_ce_chunk: int = 8192
     add_binary_head: bool = True
     attention_impl: Optional[str] = None  # "pallas" | "xla" | None=auto
 
@@ -284,7 +288,7 @@ class BertModel:
         if c.remat:
             from apex_tpu.transformer.tensor_parallel.random import checkpoint
 
-            scan_body = checkpoint(body)
+            scan_body = checkpoint(body, policy=c.remat_policy)
         x, _ = jax.lax.scan(scan_body, x, params["layers"])
         x = fused_layer_norm_affine(
             x.astype(jnp.float32),
@@ -293,21 +297,41 @@ class BertModel:
         )
         return x.astype(c.compute_dtype)
 
-    def lm_logits(self, params, hidden) -> jnp.ndarray:
-        """MLM head → vocab-parallel logits (b, s, vocab/tp)."""
+    def mlm_hidden(self, params, hidden) -> jnp.ndarray:
+        """MLM head transform (dense + GELU + LN) before the tied vocab
+        projection."""
         c = self.config
         hd = params["lm_head"]
         h = jnp.matmul(hidden, hd["dense"]["weight"].astype(hidden.dtype))
         h = jax.nn.gelu(
             h + hd["dense"]["bias"].astype(h.dtype), approximate=True
         )
-        h = fused_layer_norm_affine(
+        return fused_layer_norm_affine(
             h.astype(jnp.float32), hd["ln"]["scale"], hd["ln"]["bias"],
             (c.hidden_size,), eps=c.layernorm_epsilon,
         ).astype(hidden.dtype)
+
+    def lm_logits(self, params, hidden) -> jnp.ndarray:
+        """MLM head → vocab-parallel logits (b, s, vocab/tp)."""
+        h = self.mlm_hidden(params, hidden)
         w = params["embedding"]["weight"].astype(h.dtype)  # (vocab/tp, h)
         logits = jnp.einsum("bsh,vh->bsv", h, w)
-        return logits + hd["bias"].astype(logits.dtype)
+        return logits + params["lm_head"]["bias"].astype(logits.dtype)
+
+    def _per_token_ce(self, params, hidden, labels) -> jnp.ndarray:
+        """Per-token MLM CE through the tied head incl. its per-vocab
+        bias (fused or two-step, by ``config.fused_ce``)."""
+        from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+            lm_head_cross_entropy,
+        )
+
+        return lm_head_cross_entropy(
+            self.mlm_hidden(params, hidden),
+            params["embedding"]["weight"], labels,
+            axis_name=self.axis_name, fused=self.config.fused_ce,
+            chunk=self.config.fused_ce_chunk,
+            bias=params["lm_head"]["bias"],
+        )
 
     def binary_logits(self, params, hidden) -> jnp.ndarray:
         """Pooled [CLS] → 2-way head (reference: NSP/SOP head)."""
@@ -339,10 +363,12 @@ class BertModel:
     ) -> jnp.ndarray:
         """Masked-LM CE averaged over masked positions (+ binary CE),
         pmean over dp (reference: standalone BERT's loss_func)."""
-        lm, binary = self.apply(params, tokens, attention_mask, tokentype_ids)
-        per_token = vocab_parallel_cross_entropy(
-            lm, lm_labels, axis_name=self.axis_name
+        hidden = self.encode(params, tokens, attention_mask, tokentype_ids)
+        binary = (
+            self.binary_logits(params, hidden)
+            if self.config.add_binary_head else None
         )
+        per_token = self._per_token_ce(params, hidden, lm_labels)
         mask = loss_mask.astype(jnp.float32)
         # global masked mean: psum numerator and denominator separately —
         # a pmean of per-shard ratios would weight shards with different

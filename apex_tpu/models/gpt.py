@@ -422,22 +422,16 @@ class GPTModel:
         return self.logits(params, hidden)
 
     def _per_token_ce(self, params, hidden, targets) -> jnp.ndarray:
-        """Per-token CE through the tied LM head: fused (head folded
-        into a chunked online-logsumexp, logits never materialized) or
-        the two-step logits path, by ``config.fused_ce``."""
-        if self.config.fused_ce:
-            from apex_tpu.transformer.tensor_parallel.cross_entropy import (
-                vocab_parallel_cross_entropy_from_hidden,
-            )
+        """Per-token CE through the tied LM head (fused or two-step, by
+        ``config.fused_ce``)."""
+        from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+            lm_head_cross_entropy,
+        )
 
-            return vocab_parallel_cross_entropy_from_hidden(
-                hidden, params["embedding"]["weight"], targets,
-                axis_name=self.axis_name,
-                chunk=self.config.fused_ce_chunk,
-            )
-        logits = self.logits(params, hidden)
-        return vocab_parallel_cross_entropy(
-            logits, targets, axis_name=self.axis_name
+        return lm_head_cross_entropy(
+            hidden, params["embedding"]["weight"], targets,
+            axis_name=self.axis_name, fused=self.config.fused_ce,
+            chunk=self.config.fused_ce_chunk,
         )
 
     def loss(
@@ -547,8 +541,9 @@ class GPTModel:
         (PIPELINE_MEMORY.json: flat temp memory from 2 to 32
         microbatches).  Prefer this over ``jax.grad(pipeline_loss)``
         for deep gradient accumulation.  Same placement contract as
-        :meth:`pipeline_loss`; grads come back dp-shard-local with
-        shared-param sync already applied."""
+        :meth:`pipeline_loss`; the returned grads already have the
+        shared-param sync AND the dp pmean applied — step the optimizer
+        with them directly (do not psum over dp again)."""
         from apex_tpu.transformer.pipeline_parallel import (
             pipeline_1f1b,
             sync_replicated_grads,

@@ -74,8 +74,10 @@ class T5Config:
     # an amp.Policy drives the dtypes, as in GPTConfig/BertConfig
     policy: Optional[Any] = None
     remat: bool = True
-    # same measured default as GPTConfig (PROFILE_r03.md exp 1)
+    # same measured defaults as GPTConfig (PROFILE_r03.md exps 1 and 5)
     remat_policy: Optional[str] = "dots_with_no_batch_dims_saveable"
+    fused_ce: bool = True
+    fused_ce_chunk: int = 8192
     attention_impl: Optional[str] = None
 
     def __post_init__(self):
@@ -355,11 +357,23 @@ class T5Model:
         memory = self.encode(params, enc_tokens)
         return self.logits(params, self.decode(params, dec_tokens, memory))
 
-    def loss(self, params, enc_tokens, dec_tokens, targets) -> jnp.ndarray:
-        logits = self.apply(params, enc_tokens, dec_tokens)
-        per_token = vocab_parallel_cross_entropy(
-            logits, targets, axis_name=self.axis_name
+    def _per_token_ce(self, params, hidden, targets) -> jnp.ndarray:
+        """Per-token CE through the tied LM head (fused or two-step, by
+        ``config.fused_ce``)."""
+        from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+            lm_head_cross_entropy,
         )
+
+        return lm_head_cross_entropy(
+            hidden, params["embedding"]["weight"], targets,
+            axis_name=self.axis_name, fused=self.config.fused_ce,
+            chunk=self.config.fused_ce_chunk,
+        )
+
+    def loss(self, params, enc_tokens, dec_tokens, targets) -> jnp.ndarray:
+        memory = self.encode(params, enc_tokens)
+        hidden = self.decode(params, dec_tokens, memory)
+        per_token = self._per_token_ce(params, hidden, targets)
         return jax.lax.pmean(jnp.mean(per_token), DATA_PARALLEL_AXIS)
 
     # ------------------------------------------------------ pipeline path
@@ -482,10 +496,7 @@ class T5Model:
                 params["dec_final_ln"]["bias"],
                 (c.hidden_size,), eps=c.layernorm_epsilon,
             ).astype(c.compute_dtype)
-            per_token = vocab_parallel_cross_entropy(
-                self.logits(params, x), m["targets"],
-                axis_name=self.axis_name,
-            )
+            per_token = self._per_token_ce(params, x, m["targets"])
             return jnp.mean(per_token)
 
         per_micro = pipeline_encdec(

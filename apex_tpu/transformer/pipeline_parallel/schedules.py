@@ -334,29 +334,57 @@ def pipeline_1f1b(
         # (per-stage remat) and pull the cotangent through
         y_rec, stage_vjp = jax.vjp(stage_fn, params, x_saved)
 
-        # the exit stage seeds its own cotangent from the loss head —
-        # one head application per tick, so the head runs ~(T/M)·M ≈ M
-        # times total, not once per stage per tick
-        loss_m, head_vjp = jax.vjp(
-            lambda prm, yy: last_fn(prm, yy, mb_b), params, y_rec
-        )
+        # the exit stage seeds its own cotangent from the loss head.
+        # lax.cond keeps the head (and below, the embedding vjp) off the
+        # other stages' per-tick execution: the exit stage still pays it
+        # every tick — it cannot be hoisted like the GPipe path's
+        # _head_pass because its cotangent must feed the backward in the
+        # same tick — so the total head cost is T = M + 2pp - 2
+        # applications vs the hoisted schedule's M.  Safe in SPMD: every
+        # device with the same pipeline rank takes the same branch, so
+        # the head's tp collectives stay consistent within their groups.
         is_exit = stage == pp - 1
-        head_seed = _cast_varying(
-            jnp.where(is_exit & bwd_valid, loss_seed, 0.0),
-            _vma_union(loss_m),
+
+        def head_branch(prm, yy, mb):
+            loss_m, head_vjp = jax.vjp(
+                lambda p_, y_: last_fn(p_, y_, mb), prm, yy
+            )
+            seed = _cast_varying(
+                jnp.where(bwd_valid, loss_seed, 0.0), _vma_union(loss_m)
+            )
+            dprm, dy_h = head_vjp(seed)
+            return loss_m, dprm, dy_h
+
+        def head_zero(prm, yy, mb):
+            return (
+                # the live branch's loss varies over the pipeline axis
+                # (y_rec does); the probe was computed outside the ring
+                _cast_varying(
+                    loss_probe * 0, _vma_union(loss_probe) | {axis_name}
+                ),
+                jax.tree.map(lambda p_: p_ * 0, prm),
+                jax.tree.map(lambda a: a * 0, yy),
+            )
+
+        loss_m, dparams_head, dy_head = lax.cond(
+            is_exit, head_branch, head_zero, params, y_rec, mb_b
         )
-        dparams_head, dy_head = head_vjp(head_seed)
 
         dy = _where_tree(is_exit, dy_head, bwd_ct)
         dy = _where_tree(bwd_valid, dy, jax.tree.map(jnp.zeros_like, dy))
         dparams_stage, dx = stage_vjp(dy)
 
         # pipeline-entry cotangent feeds the embedding (stage 0 only)
-        demb_ct = _where_tree(
-            stage == 0, dx, jax.tree.map(jnp.zeros_like, dx)
-        )
-        _, emb_vjp = jax.vjp(lambda prm: first_fn(prm, mb_b), params)
-        (dparams_emb,) = emb_vjp(demb_ct)
+        def emb_branch(prm, ct, mb):
+            _, emb_vjp = jax.vjp(lambda p_: first_fn(p_, mb), prm)
+            (dprm,) = emb_vjp(ct)
+            return dprm
+
+        def emb_zero(prm, ct, mb):
+            return jax.tree.map(lambda p_: p_ * 0, prm)
+
+        dparams_emb = lax.cond(stage == 0, emb_branch, emb_zero,
+                               params, dx, mb_b)
 
         grads = jax.tree.map(
             lambda g, a, b, c: g + a + b + c,

@@ -23,7 +23,34 @@ from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
 __all__ = [
     "vocab_parallel_cross_entropy",
     "vocab_parallel_cross_entropy_from_hidden",
+    "lm_head_cross_entropy",
 ]
+
+
+def lm_head_cross_entropy(
+    hidden: jnp.ndarray,
+    weight: jnp.ndarray,
+    targets: jnp.ndarray,
+    *,
+    axis_name: str = TENSOR_PARALLEL_AXIS,
+    fused: bool = True,
+    chunk: int = 8192,
+    bias: "jnp.ndarray | None" = None,
+) -> jnp.ndarray:
+    """Per-token CE through a tied, vocab-sharded LM head — the one
+    dispatch shared by the GPT / BERT / T5 loss paths: the fused
+    chunked path (:func:`vocab_parallel_cross_entropy_from_hidden`,
+    logits never materialized) when ``fused``, else explicit logits +
+    :func:`vocab_parallel_cross_entropy`."""
+    if fused:
+        return vocab_parallel_cross_entropy_from_hidden(
+            hidden, weight, targets,
+            axis_name=axis_name, chunk=chunk, bias=bias,
+        )
+    logits = jnp.einsum("...h,vh->...v", hidden, weight.astype(hidden.dtype))
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
+    return vocab_parallel_cross_entropy(logits, targets, axis_name)
 
 
 def vocab_parallel_cross_entropy(
@@ -98,13 +125,13 @@ def _vocab_range(weight, axis_name):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _ce_from_hidden(x, weight, target, axis_name, chunk):
-    loss, _ = _ce_fwd_scan(x, weight, target, axis_name, chunk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _ce_from_hidden(x, weight, bias, target, axis_name, chunk):
+    loss, _ = _ce_fwd_scan(x, weight, bias, target, axis_name, chunk)
     return loss
 
 
-def _ce_fwd_scan(x, weight, target, axis_name, chunk):
+def _ce_fwd_scan(x, weight, bias, target, axis_name, chunk):
     """Online log-sum-exp over vocab chunks; returns (loss, residuals)."""
     n = x.shape[0]
     num_chunks = weight.shape[0] // chunk
@@ -119,6 +146,9 @@ def _ce_fwd_scan(x, weight, target, axis_name, chunk):
             "nh,vh->nv", x, w_c.astype(x.dtype),
             preferred_element_type=jnp.float32,
         )
+        logits_c = logits_c + lax.dynamic_slice_in_dim(
+            bias, c * chunk, chunk, axis=0
+        ).astype(jnp.float32)[None, :]
         m_c = jnp.max(logits_c, axis=-1)
         m_new = jnp.maximum(m, m_c)
         se = se * jnp.exp(m - m_new) + jnp.sum(
@@ -151,18 +181,19 @@ def _ce_fwd_scan(x, weight, target, axis_name, chunk):
         jnp.where(in_range, tl - global_max, 0.0), axis_name
     )
     loss = jnp.log(sum_exp) - target_logit
-    residuals = (x, weight, local_target, in_range, global_max, sum_exp)
+    residuals = (x, weight, bias, local_target, in_range, global_max,
+                 sum_exp)
     return loss, residuals
 
 
-def _ce_fwd(x, weight, target, axis_name, chunk):
-    return _ce_fwd_scan(x, weight, target, axis_name, chunk)
+def _ce_fwd(x, weight, bias, target, axis_name, chunk):
+    return _ce_fwd_scan(x, weight, bias, target, axis_name, chunk)
 
 
 def _ce_bwd(axis_name, chunk, residuals, g):
     """dlogits = softmax − one-hot, re-derived chunk-by-chunk (logits are
     recomputed, never stored); dx accumulates across chunks, dW stacks."""
-    x, weight, local_target, in_range, global_max, sum_exp = residuals
+    x, weight, bias, local_target, in_range, global_max, sum_exp = residuals
     num_chunks = weight.shape[0] // chunk
     gf = g.astype(jnp.float32)
 
@@ -172,6 +203,9 @@ def _ce_bwd(axis_name, chunk, residuals, g):
             "nh,vh->nv", x, w_c.astype(x.dtype),
             preferred_element_type=jnp.float32,
         )
+        logits_c = logits_c + lax.dynamic_slice_in_dim(
+            bias, c * chunk, chunk, axis=0
+        ).astype(jnp.float32)[None, :]
         p_c = jnp.exp(logits_c - global_max[:, None]) / sum_exp[:, None]
         idx = local_target - c * chunk
         in_chunk = in_range & (idx >= 0) & (idx < chunk)
@@ -189,15 +223,17 @@ def _ce_bwd(axis_name, chunk, residuals, g):
             "nv,nh->vh", dlogits.astype(x.dtype), x,
             preferred_element_type=jnp.float32,
         )
-        return dx, dw_c
+        db_c = jnp.sum(dlogits, axis=0)
+        return dx, (dw_c, db_c)
 
-    dx, dw = lax.scan(
+    dx, (dw, db) = lax.scan(
         body,
         _varying_like(jnp.zeros(x.shape, jnp.float32), axis_name,
                       x, weight, g),
         jnp.arange(num_chunks),
     )
     dw = dw.reshape(weight.shape).astype(weight.dtype)
+    db = db.reshape(bias.shape).astype(bias.dtype)
     # every vocab shard holds part of the softmax row: the hidden grad is
     # the sum of the per-shard contributions (the two-step path gets this
     # psum from the einsum transpose automatically)
@@ -207,7 +243,8 @@ def _ce_bwd(axis_name, chunk, residuals, g):
     # tp-varying only, and the einsum transpose would psum over dp)
     dx = _psum_down_to(dx, x)
     dw = _psum_down_to(dw, weight)
-    return dx.astype(x.dtype), dw, None
+    db = _psum_down_to(db, bias)
+    return dx.astype(x.dtype), dw, db, None
 
 
 def _psum_down_to(val, primal):
@@ -231,6 +268,7 @@ def vocab_parallel_cross_entropy_from_hidden(
     target: jnp.ndarray,
     axis_name: str = TENSOR_PARALLEL_AXIS,
     chunk: int = 4096,
+    bias: "jnp.ndarray | None" = None,
 ) -> jnp.ndarray:
     """Fused LM-head + vocab-parallel CE: per-token loss straight from
     hidden states and the (tied, vocab-sharded) embedding weight, with
@@ -246,8 +284,9 @@ def vocab_parallel_cross_entropy_from_hidden(
     cross_entropy.py, which still materializes logits).
 
     ``hidden``: (..., h); ``weight``: (vocab/tp, h); ``target``: (...)
-    global ids.  Returns (...) fp32 losses.  Falls back to the two-step
-    path when vocab/tp is not divisible by ``chunk``.
+    global ids; optional ``bias``: (vocab/tp,) per-vocab logit bias (the
+    BERT MLM head's).  Returns (...) fp32 losses.  Falls back to the
+    two-step path when vocab/tp is not divisible by ``chunk``.
     """
     lead = hidden.shape[:-1]
     h = hidden.shape[-1]
@@ -255,7 +294,11 @@ def vocab_parallel_cross_entropy_from_hidden(
         logits = jnp.einsum(
             "...h,vh->...v", hidden, weight.astype(hidden.dtype)
         )
+        if bias is not None:
+            logits = logits + bias.astype(logits.dtype)
         return vocab_parallel_cross_entropy(logits, target, axis_name)
+    if bias is None:
+        bias = jnp.zeros((weight.shape[0],), jnp.float32)
     x = hidden.reshape(-1, h)
     t = target.reshape(-1)
-    return _ce_from_hidden(x, weight, t, axis_name, chunk).reshape(lead)
+    return _ce_from_hidden(x, weight, bias, t, axis_name, chunk).reshape(lead)

@@ -312,3 +312,54 @@ def test_ce_from_hidden_matches_two_step():
         np.testing.assert_allclose(float(val), float(l2), rtol=1e-5)
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def test_ce_from_hidden_with_bias_matches():
+    """Fused CE with a per-vocab bias (the BERT MLM head shape) == the
+    two-step logits+bias path, values and all three grads."""
+    from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+        vocab_parallel_cross_entropy,
+        vocab_parallel_cross_entropy_from_hidden,
+    )
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=4
+    )
+    try:
+        n, h, vocab, chunk = 12, 16, 32, 4
+        x = jax.random.normal(jax.random.PRNGKey(0), (n, h), jnp.float32)
+        w = 0.5 * jax.random.normal(
+            jax.random.PRNGKey(1), (vocab, h), jnp.float32
+        )
+        bias = 0.3 * jax.random.normal(
+            jax.random.PRNGKey(3), (vocab,), jnp.float32
+        )
+        t = jax.random.randint(jax.random.PRNGKey(2), (n,), 0, vocab)
+
+        def fused(x, w, b, t):
+            return jnp.mean(vocab_parallel_cross_entropy_from_hidden(
+                x, w, t, chunk=chunk, bias=b
+            ))
+
+        def two_step(x, w, b, t):
+            logits = jnp.einsum("nh,vh->nv", x, w) + b[None, :]
+            return jnp.mean(vocab_parallel_cross_entropy(logits, t))
+
+        wspec = P("tp", None)
+        bspec = P("tp")
+        outs = {}
+        for name, fn in (("fused", fused), ("two_step", two_step)):
+            vg = jax.jit(jax.shard_map(
+                jax.value_and_grad(fn, argnums=(0, 1, 2)), mesh=mesh,
+                in_specs=(P(), wspec, bspec, P()),
+                out_specs=(P(), (P(), wspec, bspec)),
+            ))
+            outs[name] = vg(x, w, bias, t)
+        (lf, gf), (l2, g2) = outs["fused"], outs["two_step"]
+        np.testing.assert_allclose(float(lf), float(l2), rtol=1e-5)
+        for a, b in zip(gf, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )
+    finally:
+        parallel_state.destroy_model_parallel()
