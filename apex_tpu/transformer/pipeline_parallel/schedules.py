@@ -645,18 +645,47 @@ def forward_backward_pipelining_with_interleaving(
 def get_forward_backward_func(
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     pipeline_model_parallel_size: int = 1,
+    model_type: Optional[Any] = None,
 ):
-    """(reference: schedules/__init__.py:1-39)
+    """(reference: schedules/__init__.py:1-39 + ModelType routing in
+    schedules/common.py:18-108)
 
-    All three returned callables share the signature
+    The returned callables share the signature
     ``fn(first_fn, stage_fn, last_fn, microbatches, **kw)`` — the
     interleaved case has ``num_model_chunks`` pre-bound, and its
     ``stage_fn`` is called as ``stage_fn(x, chunk_idx)`` (select chunk
-    params with ``lax.dynamic_index_in_dim``)."""
-    if pipeline_model_parallel_size > 1:
-        if virtual_pipeline_model_parallel_size is not None:
-            import functools
+    params with ``lax.dynamic_index_in_dim``).  With
+    ``model_type=ModelType.encoder_and_decoder`` and pp > 1 the
+    encoder-decoder schedule is returned, pre-bound to the installed
+    ``pipeline_model_parallel_split_rank``; its signature is
+    ``fn(enc_entry_fn, enc_stage_fn, dec_entry_fn, dec_stage_fn,
+    last_fn, microbatches, **kw)`` (see :func:`pipeline_encdec`)."""
+    from apex_tpu.transformer.enums import ModelType
 
+    if (
+        model_type == ModelType.encoder_and_decoder
+        and pipeline_model_parallel_size <= 1
+    ):
+        raise ValueError(
+            "ModelType.encoder_and_decoder has no no-pipelining schedule "
+            "(the sequential path is the model's own loss, e.g. "
+            "T5Model.loss); use pipeline_model_parallel_size > 1"
+        )
+    if pipeline_model_parallel_size > 1:
+        import functools
+
+        if model_type == ModelType.encoder_and_decoder:
+            from apex_tpu.transformer import parallel_state
+
+            split = parallel_state.get_pipeline_model_parallel_split_rank()
+            if split is None:
+                raise RuntimeError(
+                    "ModelType.encoder_and_decoder needs "
+                    "pipeline_model_parallel_split_rank_ at "
+                    "initialize_model_parallel time"
+                )
+            return functools.partial(pipeline_encdec, split_stage=split)
+        if virtual_pipeline_model_parallel_size is not None:
             return functools.partial(
                 forward_backward_pipelining_with_interleaving,
                 num_model_chunks=virtual_pipeline_model_parallel_size,

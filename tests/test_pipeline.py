@@ -379,11 +379,12 @@ def test_lm_head_runs_once_per_microbatch():
         parallel_state.destroy_model_parallel()
 
 
-@pytest.mark.parametrize("micro", [4, 8])
+@pytest.mark.parametrize("micro", [1, 2, 4, 8])
 def test_1f1b_matches_serial(micro):
     """True 1F1B (fwd/bwd interleaved in one scan, O(pp) activation
     state) == serial dense math, losses and grads (reference:
-    fwd_bwd_pipelining_without_interleaving.py:112-149 steady state)."""
+    fwd_bwd_pipelining_without_interleaving.py:112-149 steady state).
+    micro < pp (1, 2) exercises the pure-bubble regime."""
     from apex_tpu.transformer.pipeline_parallel import pipeline_1f1b
 
     mesh = parallel_state.initialize_model_parallel(
@@ -433,6 +434,43 @@ def test_1f1b_matches_serial(micro):
         for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_get_forward_backward_func_encdec_dispatch():
+    """ModelType.encoder_and_decoder routes to the enc-dec schedule with
+    the installed split rank pre-bound (reference: ModelType routing)."""
+    import functools
+
+    from apex_tpu.transformer.enums import ModelType
+    from apex_tpu.transformer.pipeline_parallel import (
+        get_forward_backward_func,
+        pipeline_encdec,
+    )
+
+    parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4,
+        pipeline_model_parallel_split_rank_=2,
+    )
+    try:
+        fn = get_forward_backward_func(
+            pipeline_model_parallel_size=4,
+            model_type=ModelType.encoder_and_decoder,
+        )
+        assert isinstance(fn, functools.partial)
+        assert fn.func is pipeline_encdec
+        assert fn.keywords["split_stage"] == 2
+    finally:
+        parallel_state.destroy_model_parallel()
+    # without a split rank installed: clear error
+    parallel_state.initialize_model_parallel(pipeline_model_parallel_size_=4)
+    try:
+        with pytest.raises(RuntimeError):
+            get_forward_backward_func(
+                pipeline_model_parallel_size=4,
+                model_type=ModelType.encoder_and_decoder,
             )
     finally:
         parallel_state.destroy_model_parallel()
