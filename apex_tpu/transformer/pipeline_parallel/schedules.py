@@ -675,6 +675,11 @@ def get_forward_backward_func(
         import functools
 
         if model_type == ModelType.encoder_and_decoder:
+            if virtual_pipeline_model_parallel_size is not None:
+                raise ValueError(
+                    "encoder_and_decoder pipelines do not support virtual "
+                    "(interleaved) pipeline stages"
+                )
             from apex_tpu.transformer import parallel_state
 
             split = parallel_state.get_pipeline_model_parallel_split_rank()

@@ -36,6 +36,7 @@ def lm_head_cross_entropy(
     fused: bool = True,
     chunk: int = 8192,
     bias: "jnp.ndarray | None" = None,
+    smoothing: float = 0.0,
 ) -> jnp.ndarray:
     """Per-token CE through a tied, vocab-sharded LM head — the one
     dispatch shared by the GPT / BERT / T5 loss paths: the fused
@@ -46,22 +47,28 @@ def lm_head_cross_entropy(
         return vocab_parallel_cross_entropy_from_hidden(
             hidden, weight, targets,
             axis_name=axis_name, chunk=chunk, bias=bias,
+            smoothing=smoothing,
         )
     logits = jnp.einsum("...h,vh->...v", hidden, weight.astype(hidden.dtype))
     if bias is not None:
         logits = logits + bias.astype(logits.dtype)
-    return vocab_parallel_cross_entropy(logits, targets, axis_name)
+    return vocab_parallel_cross_entropy(
+        logits, targets, axis_name, smoothing=smoothing
+    )
 
 
 def vocab_parallel_cross_entropy(
     vocab_parallel_logits: jnp.ndarray,
     target: jnp.ndarray,
     axis_name: str = TENSOR_PARALLEL_AXIS,
+    smoothing: float = 0.0,
 ) -> jnp.ndarray:
     """Per-token CE loss from vocab-sharded logits — call inside shard_map.
 
     ``vocab_parallel_logits``: (..., vocab/tp) local shard.
     ``target``: (...) int ids in the *global* vocab.
+    ``smoothing``: uniform label smoothing over the global vocab
+    (contrib.xentropy semantics).
     Returns (...) float32 losses.
     """
     logits = vocab_parallel_logits.astype(jnp.float32)
@@ -89,6 +96,16 @@ def vocab_parallel_cross_entropy(
     picked = jnp.where(in_range, picked, 0.0)
     target_logit = jax.lax.psum(picked, axis_name)
 
+    if smoothing > 0.0:
+        vocab_global = per * world
+        mean_logit = (
+            jax.lax.psum(jnp.sum(logits, axis=-1), axis_name) / vocab_global
+        )
+        return (
+            jnp.log(sum_exp)
+            - (1.0 - smoothing) * target_logit
+            - smoothing * mean_logit
+        )
     return jnp.log(sum_exp) - target_logit
 
 
@@ -125,13 +142,14 @@ def _vocab_range(weight, axis_name):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _ce_from_hidden(x, weight, bias, target, axis_name, chunk):
-    loss, _ = _ce_fwd_scan(x, weight, bias, target, axis_name, chunk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _ce_from_hidden(x, weight, bias, target, axis_name, chunk, smoothing):
+    loss, _ = _ce_fwd_scan(x, weight, bias, target, axis_name, chunk,
+                           smoothing)
     return loss
 
 
-def _ce_fwd_scan(x, weight, bias, target, axis_name, chunk):
+def _ce_fwd_scan(x, weight, bias, target, axis_name, chunk, smoothing):
     """Online log-sum-exp over vocab chunks; returns (loss, residuals)."""
     n = x.shape[0]
     num_chunks = weight.shape[0] // chunk
@@ -140,7 +158,7 @@ def _ce_fwd_scan(x, weight, bias, target, axis_name, chunk):
     local_target = jnp.where(in_range, target - start, 0)
 
     def body(carry, c):
-        m, se, tl = carry
+        m, se, tl, sl = carry
         w_c = lax.dynamic_slice_in_dim(weight, c * chunk, chunk, axis=0)
         logits_c = jnp.einsum(
             "nh,vh->nv", x, w_c.astype(x.dtype),
@@ -160,7 +178,9 @@ def _ce_fwd_scan(x, weight, bias, target, axis_name, chunk):
             logits_c, jnp.clip(idx, 0, chunk - 1)[:, None], axis=-1
         )[:, 0]
         tl = jnp.where(in_chunk, picked, tl)
-        return (m_new, se, tl), None
+        if smoothing > 0.0:  # static: no dead logit-sum on the usual path
+            sl = sl + jnp.sum(logits_c, axis=-1)
+        return (m_new, se, tl, sl), None
 
     init = jax.tree.map(
         lambda a: _varying_like(a, axis_name, x, weight, target),
@@ -168,9 +188,10 @@ def _ce_fwd_scan(x, weight, bias, target, axis_name, chunk):
             jnp.full((n,), -jnp.inf, jnp.float32),
             jnp.zeros((n,), jnp.float32),
             jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32),
         ),
     )
-    (m, se, tl), _ = lax.scan(body, init, jnp.arange(num_chunks))
+    (m, se, tl, sl), _ = lax.scan(body, init, jnp.arange(num_chunks))
 
     # identical 3-collective math to vocab_parallel_cross_entropy: the
     # max is a stop-gradient constant, sum-exp and the owning shard's
@@ -181,20 +202,31 @@ def _ce_fwd_scan(x, weight, bias, target, axis_name, chunk):
         jnp.where(in_range, tl - global_max, 0.0), axis_name
     )
     loss = jnp.log(sum_exp) - target_logit
+    if smoothing > 0.0:
+        # label smoothing over the GLOBAL vocab (contrib.xentropy
+        # semantics): loss = lse - (1-s)*target - s*mean(logits)
+        vocab_global = weight.shape[0] * lax.axis_size(axis_name)
+        mean_logit = lax.psum(sl, axis_name) / vocab_global - global_max
+        loss = (
+            jnp.log(sum_exp)
+            - (1.0 - smoothing) * target_logit
+            - smoothing * mean_logit
+        )
     residuals = (x, weight, bias, local_target, in_range, global_max,
                  sum_exp)
     return loss, residuals
 
 
-def _ce_fwd(x, weight, bias, target, axis_name, chunk):
-    return _ce_fwd_scan(x, weight, bias, target, axis_name, chunk)
+def _ce_fwd(x, weight, bias, target, axis_name, chunk, smoothing):
+    return _ce_fwd_scan(x, weight, bias, target, axis_name, chunk, smoothing)
 
 
-def _ce_bwd(axis_name, chunk, residuals, g):
+def _ce_bwd(axis_name, chunk, smoothing, residuals, g):
     """dlogits = softmax − one-hot, re-derived chunk-by-chunk (logits are
     recomputed, never stored); dx accumulates across chunks, dW stacks."""
     x, weight, bias, local_target, in_range, global_max, sum_exp = residuals
     num_chunks = weight.shape[0] // chunk
+    vocab_global = weight.shape[0] * lax.axis_size(axis_name)
     gf = g.astype(jnp.float32)
 
     def body(dx, c):
@@ -214,7 +246,11 @@ def _ce_bwd(axis_name, chunk, residuals, g):
                            dtype=jnp.float32)
             * in_chunk[:, None]
         )
-        dlogits = (p_c - onehot) * gf[:, None]
+        # d loss/d logits = softmax - (1-s)*onehot - s/V (kernel bprop
+        # form, matching contrib.xentropy)
+        dlogits = (
+            p_c - (1.0 - smoothing) * onehot - smoothing / vocab_global
+        ) * gf[:, None]
         dx = dx + jnp.einsum(
             "nv,vh->nh", dlogits.astype(x.dtype), w_c.astype(x.dtype),
             preferred_element_type=jnp.float32,
@@ -269,6 +305,7 @@ def vocab_parallel_cross_entropy_from_hidden(
     axis_name: str = TENSOR_PARALLEL_AXIS,
     chunk: int = 4096,
     bias: "jnp.ndarray | None" = None,
+    smoothing: float = 0.0,
 ) -> jnp.ndarray:
     """Fused LM-head + vocab-parallel CE: per-token loss straight from
     hidden states and the (tied, vocab-sharded) embedding weight, with
@@ -285,8 +322,10 @@ def vocab_parallel_cross_entropy_from_hidden(
 
     ``hidden``: (..., h); ``weight``: (vocab/tp, h); ``target``: (...)
     global ids; optional ``bias``: (vocab/tp,) per-vocab logit bias (the
-    BERT MLM head's).  Returns (...) fp32 losses.  Falls back to the
-    two-step path when vocab/tp is not divisible by ``chunk``.
+    BERT MLM head's); ``smoothing``: uniform label smoothing over the
+    global vocab (contrib.xentropy semantics).  Returns (...) fp32
+    losses.  Falls back to the two-step path when vocab/tp is not
+    divisible by ``chunk``.
     """
     lead = hidden.shape[:-1]
     h = hidden.shape[-1]
@@ -296,9 +335,13 @@ def vocab_parallel_cross_entropy_from_hidden(
         )
         if bias is not None:
             logits = logits + bias.astype(logits.dtype)
-        return vocab_parallel_cross_entropy(logits, target, axis_name)
+        return vocab_parallel_cross_entropy(
+            logits, target, axis_name, smoothing=smoothing
+        )
     if bias is None:
         bias = jnp.zeros((weight.shape[0],), jnp.float32)
     x = hidden.reshape(-1, h)
     t = target.reshape(-1)
-    return _ce_from_hidden(x, weight, bias, t, axis_name, chunk).reshape(lead)
+    return _ce_from_hidden(
+        x, weight, bias, t, axis_name, chunk, float(smoothing)
+    ).reshape(lead)

@@ -537,7 +537,7 @@ def _flash_long_seq(out, on_tpu, timeit):
     out["flash_long_seq"] = {
         "seq": S_long, "shape": [bq, hq, S_long, dq], "dtype": "bfloat16",
         "causal": True,
-        "fwd_bwd_ms": timeit(fa_grad, q, k, v, n=10),
+        "fwd_bwd_ms": round(timeit(fa_grad, q, k, v, n=10), 2),
     }
     log(f"flash s={S_long}: {out['flash_long_seq']['fwd_bwd_ms']:.2f} ms fwd+bwd")
 

@@ -363,3 +363,57 @@ def test_ce_from_hidden_with_bias_matches():
             )
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def test_ce_smoothing_matches_contrib_xentropy():
+    """Label-smoothed vocab-parallel CE (two-step AND fused-from-hidden)
+    == the single-device contrib.xentropy formula, values and grads."""
+    from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+        vocab_parallel_cross_entropy,
+        vocab_parallel_cross_entropy_from_hidden,
+    )
+
+    s = 0.1
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=4
+    )
+    try:
+        n, h, vocab, chunk = 12, 16, 32, 4
+        x = jax.random.normal(jax.random.PRNGKey(0), (n, h), jnp.float32)
+        w = 0.5 * jax.random.normal(
+            jax.random.PRNGKey(1), (vocab, h), jnp.float32
+        )
+        t = jax.random.randint(jax.random.PRNGKey(2), (n,), 0, vocab)
+
+        # single-device reference: dense logits + contrib formula
+        def ref(x, w, t):
+            from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+            return jnp.mean(softmax_cross_entropy_loss(
+                jnp.einsum("nh,vh->nv", x, w), t, smoothing=s
+            ))
+
+        ref_loss, ref_dx = jax.value_and_grad(ref)(x, w, t)
+
+        wspec = P("tp", None)
+        for name, fn in (
+            ("fused", lambda x, w, t: jnp.mean(
+                vocab_parallel_cross_entropy_from_hidden(
+                    x, w, t, chunk=chunk, smoothing=s))),
+            ("two_step", lambda x, w, t: jnp.mean(
+                vocab_parallel_cross_entropy(
+                    jnp.einsum("nh,vh->nv", x, w), t, smoothing=s))),
+        ):
+            vg = jax.jit(jax.shard_map(
+                jax.value_and_grad(fn), mesh=mesh,
+                in_specs=(P(), wspec, P()), out_specs=(P(), P()),
+            ))
+            loss, dx = vg(x, w, t)
+            np.testing.assert_allclose(
+                float(loss), float(ref_loss), rtol=1e-5, err_msg=name
+            )
+            np.testing.assert_allclose(
+                np.asarray(dx), np.asarray(ref_dx), rtol=1e-4, atol=1e-6,
+                err_msg=name,
+            )
+    finally:
+        parallel_state.destroy_model_parallel()
