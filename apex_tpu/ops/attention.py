@@ -185,10 +185,24 @@ class _FAConfig(NamedTuple):
     # whether the backward pass materialises dbias (False for constant
     # masks keeps the causal block-skip and avoids a (b*h, sq, sk) buffer)
     bias_grad: bool
+    # full-precision MXU passes for the in-kernel dots: set for fp32
+    # inputs, where the default (single bf16 pass) loses ~3 decimal
+    # digits vs the XLA path at long sequence lengths (KERNELS_TPU gate)
+    hi_precision: bool = False
 
 
 BIAS_PER_BATCH = -2
 BIAS_PER_HEAD = -1
+
+#: fp32 auto mode routes to XLA at or below this sequence length
+#: (measured crossover, KERNELS_TPU.json; also read by
+#: tools/kernel_validation.py so the recorded auto_impl cannot drift
+#: from the actual dispatch)
+FLASH_FP32_XLA_MAX_SEQ = 1024
+
+
+def _prec(cfg):
+    return jax.lax.Precision.HIGHEST if cfg.hi_precision else None
 
 
 # ---------------------------------------------------------------------------
@@ -232,6 +246,7 @@ def _fa_fwd_kernel(
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(cfg),
         )                                                  # (block_q, block_k)
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
@@ -266,6 +281,7 @@ def _fa_fwd_kernel(
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
             p_acc, vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(cfg),
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -415,6 +431,7 @@ def _fa_bwd_dkv_kernel(
         s = jax.lax.dot_general(
             qblk, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(cfg),
         ) * cfg.sm_scale                                   # (block_q, block_k)
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
@@ -435,6 +452,7 @@ def _fa_bwd_dkv_kernel(
         dp = jax.lax.dot_general(
             doblk, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(cfg),
         )
         if has_dropout:
             keep = _keep_mask(
@@ -449,11 +467,13 @@ def _fa_bwd_dkv_kernel(
         dv_acc[...] += jax.lax.dot_general(
             p_drop, doblk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(cfg),
         )
         dz = p * (dp - delta)                              # grad wrt s+bias
         dk_acc[...] += jax.lax.dot_general(
             dz * cfg.sm_scale, qblk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(cfg),
         )
 
     @pl.when(jq == num_q - 1)
@@ -508,6 +528,7 @@ def _fa_bwd_dq_kernel(
         s = jax.lax.dot_general(
             qblk, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(cfg),
         ) * cfg.sm_scale
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
@@ -528,6 +549,7 @@ def _fa_bwd_dq_kernel(
         dp = jax.lax.dot_general(
             doblk, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(cfg),
         )
         if has_dropout:
             keep = _keep_mask(
@@ -541,6 +563,7 @@ def _fa_bwd_dq_kernel(
         dq_acc[...] += jax.lax.dot_general(
             dz * cfg.sm_scale, kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(cfg),
         )
 
     write_kb = (num_k - 1) if emit_dbias else last_kb
@@ -769,6 +792,20 @@ def flash_attention(
             "implementation='pallas' requested but Pallas failed to import"
         )
     impl = implementation or default_implementation()
+    if (
+        implementation is None
+        and impl == "pallas"
+        and q.dtype == jnp.float32
+        and q.shape[2] <= FLASH_FP32_XLA_MAX_SEQ
+    ):
+        # measured dispatch window (KERNELS_TPU.json): fp32 inputs run
+        # the kernel dots at Precision.HIGHEST for parity, which loses
+        # to XLA at s=1024 (0.85x fwd) and wins big by s=4096 (5x+);
+        # the boundary is set at the largest measured losing shape.
+        # Auto mode routes accordingly — the analog of the reference's
+        # kernel-availability windows
+        # (apex/transformer/functional/fused_softmax.py:151-171)
+        impl = "xla"
     if pl is None:
         impl = "xla"
 
@@ -849,6 +886,7 @@ def _flash_attention_pallas(
         sm_scale=scale, causal=causal, dropout_rate=float(dropout_rate),
         block_q=block_q, block_k=block_k, q_len=sq, kv_len=sk, heads=h,
         bias_batch=bias_batch, bias_grad=bool(bias_requires_grad),
+        hi_precision=(q.dtype == jnp.float32),
     )
     out = _flash(qf, kf, vf, bias_flat, qseg, kseg, seed_arr, cfg)
     if pad_q:

@@ -94,18 +94,21 @@ def vocab_parallel_cross_entropy(
     local_target = jnp.where(in_range, target - start, 0)
     picked = jnp.take_along_axis(logits, local_target[..., None], axis=-1)[..., 0]
     picked = jnp.where(in_range, picked, 0.0)
-    target_logit = jax.lax.psum(picked, axis_name)
 
     if smoothing > 0.0:
+        # one stacked psum for target logit + logit sum (3 collectives
+        # total, with or without smoothing)
         vocab_global = per * world
-        mean_logit = (
-            jax.lax.psum(jnp.sum(logits, axis=-1), axis_name) / vocab_global
+        target_logit, logit_sum = jax.lax.psum(
+            jnp.stack([picked, jnp.sum(logits, axis=-1)]), axis_name
         )
+        mean_logit = logit_sum / vocab_global
         return (
             jnp.log(sum_exp)
             - (1.0 - smoothing) * target_logit
             - smoothing * mean_logit
         )
+    target_logit = jax.lax.psum(picked, axis_name)
     return jnp.log(sum_exp) - target_logit
 
 
@@ -198,20 +201,25 @@ def _ce_fwd_scan(x, weight, bias, target, axis_name, chunk, smoothing):
     # target logit are psum'd
     global_max = lax.pmax(lax.stop_gradient(m), axis_name)
     sum_exp = lax.psum(se * jnp.exp(m - global_max), axis_name)
-    target_logit = lax.psum(
-        jnp.where(in_range, tl - global_max, 0.0), axis_name
-    )
-    loss = jnp.log(sum_exp) - target_logit
+    picked = jnp.where(in_range, tl - global_max, 0.0)
     if smoothing > 0.0:
         # label smoothing over the GLOBAL vocab (contrib.xentropy
-        # semantics): loss = lse - (1-s)*target - s*mean(logits)
+        # semantics): loss = lse - (1-s)*target - s*mean(logits).
+        # One stacked psum carries both the target logit and the logit
+        # sum, keeping the collective count at three.
         vocab_global = weight.shape[0] * lax.axis_size(axis_name)
-        mean_logit = lax.psum(sl, axis_name) / vocab_global - global_max
+        target_logit, sl_g = lax.psum(
+            jnp.stack([picked, sl]), axis_name
+        )
+        mean_logit = sl_g / vocab_global - global_max
         loss = (
             jnp.log(sum_exp)
             - (1.0 - smoothing) * target_logit
             - smoothing * mean_logit
         )
+    else:
+        target_logit = lax.psum(picked, axis_name)
+        loss = jnp.log(sum_exp) - target_logit
     residuals = (x, weight, bias, local_target, in_range, global_max,
                  sum_exp)
     return loss, residuals

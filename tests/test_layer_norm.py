@@ -187,7 +187,9 @@ class TestKernelFallbackPolicy:
         monkeypatch.setattr(plat, "_current_platform", lambda: "tpu")
         monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
         monkeypatch.setenv("APEX_TPU_STRICT_KERNELS", "1")
-        q = jnp.ones((1, 1, 8, 8))
+        # bf16: fp32 short-seq auto-routes to XLA by measurement and
+        # would never reach the pallas machinery under test
+        q = jnp.ones((1, 1, 8, 8), jnp.bfloat16)
         with pytest.raises(KernelLoweringError):
             attn_mod.flash_attention(q, q, q, implementation=None)
 
@@ -204,9 +206,14 @@ class TestKernelFallbackPolicy:
         monkeypatch.setattr(plat, "_current_platform", lambda: "tpu")
         monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
         monkeypatch.delenv("APEX_TPU_STRICT_KERNELS", raising=False)
-        q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 8, 8))
+        q = jax.random.normal(
+            jax.random.PRNGKey(0), (1, 1, 8, 8), jnp.bfloat16
+        )
         with caplog.at_level(logging.WARNING, logger="apex_tpu"):
             out = attn_mod.flash_attention(q, q, q, implementation=None)
         assert any("falling back to XLA" in r.message for r in caplog.records)
         want = attn_mod.mha_reference(q, q, q)
-        np.testing.assert_allclose(out, want, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(want, np.float32),
+            atol=1e-2,
+        )
