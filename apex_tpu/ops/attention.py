@@ -798,12 +798,12 @@ def flash_attention(
         and q.dtype == jnp.float32
         and q.shape[2] <= FLASH_FP32_XLA_MAX_SEQ
     ):
-        # measured dispatch window (KERNELS_TPU.json): fp32 inputs run
-        # the kernel dots at Precision.HIGHEST for parity, which loses
-        # to XLA at s=1024 (0.85x fwd) and wins big by s=4096 (5x+);
-        # the boundary is set at the largest measured losing shape.
-        # Auto mode routes accordingly — the analog of the reference's
-        # kernel-availability windows
+        # measured dispatch window (KERNELS_TPU.json, fp32 entries):
+        # fp32 inputs run the kernel dots at Precision.HIGHEST for
+        # parity, which loses to XLA at s=1024 (0.8x fwd) and wins by
+        # s=4096 (>2x fwd, growing with s); the boundary is the largest
+        # measured losing shape.  Auto mode routes accordingly — the
+        # analog of the reference's kernel-availability windows
         # (apex/transformer/functional/fused_softmax.py:151-171)
         impl = "xla"
     if pl is None:

@@ -455,3 +455,47 @@ def test_masked_softmax_explicit_pallas_raises():
     # auto + explicit xla still fine
     out = scaled_masked_softmax(x, mask)
     assert out.shape == (1, 8, 8)
+
+
+class TestFp32DispatchWindow:
+    """fp32 short-seq auto mode routes to XLA (measured window,
+    KERNELS_TPU.json); bf16 and explicit requests are unaffected."""
+
+    def _spy(self, monkeypatch):
+        from apex_tpu.ops import attention as attn_mod
+        from apex_tpu.utils import platform as plat
+
+        calls = []
+
+        def fake_pallas(q, k, v, *a, **kw):
+            calls.append(q.dtype)
+            return jnp.zeros(q.shape, q.dtype)
+
+        monkeypatch.setattr(attn_mod, "_flash_attention_pallas", fake_pallas)
+        monkeypatch.setattr(plat, "_current_platform", lambda: "tpu")
+        monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
+        monkeypatch.delenv("APEX_TPU_STRICT_KERNELS", raising=False)
+        return attn_mod, calls
+
+    def test_fp32_short_seq_auto_routes_to_xla(self, monkeypatch):
+        attn_mod, calls = self._spy(monkeypatch)
+        s = attn_mod.FLASH_FP32_XLA_MAX_SEQ
+        q = jnp.ones((1, 1, 8, 8), jnp.float32)
+        attn_mod.flash_attention(q, q, q, implementation=None)
+        assert calls == []  # window fired: no pallas attempt
+
+    def test_bf16_and_explicit_fp32_still_hit_pallas(self, monkeypatch):
+        attn_mod, calls = self._spy(monkeypatch)
+        qb = jnp.ones((1, 1, 8, 8), jnp.bfloat16)
+        attn_mod.flash_attention(qb, qb, qb, implementation=None)
+        assert len(calls) == 1  # bf16 auto stays on pallas
+        qf = jnp.ones((1, 1, 8, 8), jnp.float32)
+        attn_mod.flash_attention(qf, qf, qf, implementation="pallas")
+        assert len(calls) == 2  # explicit request honored for fp32
+
+    def test_fp32_long_seq_auto_stays_pallas(self, monkeypatch):
+        attn_mod, calls = self._spy(monkeypatch)
+        s = attn_mod.FLASH_FP32_XLA_MAX_SEQ + 128
+        q = jnp.ones((1, 1, s, 8), jnp.float32)
+        attn_mod.flash_attention(q, q, q, implementation=None)
+        assert len(calls) == 1  # beyond the window: pallas
