@@ -32,6 +32,7 @@ from apex_tpu.pyprof.prof import (  # noqa: E402,F401
     classify,
     prof,
     prof_table,
+    utilization,
 )
 
 __all__ = [
@@ -43,6 +44,7 @@ __all__ = [
     "classify",
     "prof",
     "prof_table",
+    "utilization",
     "OP_CLASSES",
     "cost_analysis",
     "summarize",

@@ -22,7 +22,7 @@ from __future__ import annotations
 import re
 from typing import Any, Dict, List, Optional
 
-__all__ = ["classify", "prof", "prof_table", "OP_CLASSES"]
+__all__ = ["classify", "prof", "prof_table", "utilization", "OP_CLASSES"]
 
 
 # Each entry: (class name, regex over the normalized op name, kind).
@@ -125,6 +125,13 @@ def prof(
     return out
 
 
+def _time_by_kind(classes: List[Dict[str, Any]]) -> Dict[str, float]:
+    by_kind: Dict[str, float] = {}
+    for r in classes:
+        by_kind[r["kind"]] = by_kind.get(r["kind"], 0.0) + r["total_ms"]
+    return by_kind
+
+
 def prof_table(classes: List[Dict[str, Any]], top: Optional[int] = None) -> str:
     """Format prof() rows — the reference's per-op-class summary print."""
     lines = [
@@ -137,9 +144,7 @@ def prof_table(classes: List[Dict[str, Any]], top: Optional[int] = None) -> str:
             f"{r['op_class']:<20} {r['kind']:<11} {r['count']:>7} "
             f"{r['total_ms']:>10.3f} {r['pct']:>6.1f}  {ops[:60]}"
         )
-    by_kind: Dict[str, float] = {}
-    for r in classes:
-        by_kind[r["kind"]] = by_kind.get(r["kind"], 0.0) + r["total_ms"]
+    by_kind = _time_by_kind(classes)
     total = sum(by_kind.values()) or 1.0
     split = "  ".join(
         f"{k}: {100.0 * v / total:.1f}%" for k, v in
@@ -147,3 +152,55 @@ def prof_table(classes: List[Dict[str, Any]], top: Optional[int] = None) -> str:
     )
     lines.append(f"-- time by kind: {split}")
     return "\n".join(lines)
+
+
+def utilization(
+    classes: List[Dict[str, Any]],
+    costs: Dict[str, float],
+    peak_flops: Optional[float] = None,
+    peak_bandwidth: Optional[float] = None,
+    steps: int = 1,
+) -> Dict[str, Any]:
+    """Marry the per-class time split with XLA cost analysis — the
+    reference ``prof`` stage's FLOPs/bytes/efficiency columns
+    (reference: apex/pyprof/prof/ op-class compute of flops, bytes and
+    silicon efficiency per kernel).
+
+    ``classes``: :func:`prof` output for a trace of ``steps`` executions;
+    ``costs``: :func:`apex_tpu.pyprof.cost_analysis` of the traced fn
+    (per single execution).  Returns compute/memory time, achieved
+    FLOP/s and bytes/s, and — when peaks are given — utilization
+    fractions.
+    """
+    by_kind = _time_by_kind(classes)
+    compute_s = by_kind.get("compute", 0.0) / 1e3 / max(steps, 1)
+    memory_s = by_kind.get("memory", 0.0) / 1e3 / max(steps, 1)
+    # bandwidth follows the roofline convention: bytes over TOTAL step
+    # time (compute-class ops move most of the HBM bytes; dividing by
+    # memory-class time alone would inflate past 1.0)
+    total_s = sum(by_kind.values()) / 1e3 / max(steps, 1)
+    flops = float(costs.get("flops", 0.0))
+    bytes_accessed = float(costs.get("bytes accessed", 0.0))
+    out: Dict[str, Any] = {
+        "compute_ms": round(compute_s * 1e3, 3),
+        "memory_ms": round(memory_s * 1e3, 3),
+        "collective_ms": round(
+            by_kind.get("collective", 0.0) / max(steps, 1), 3
+        ),
+        "total_ms": round(total_s * 1e3, 3),
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "achieved_flops_per_sec": flops / compute_s if compute_s else 0.0,
+        "achieved_bytes_per_sec": (
+            bytes_accessed / total_s if total_s else 0.0
+        ),
+    }
+    if peak_flops and compute_s:
+        out["compute_utilization"] = round(
+            out["achieved_flops_per_sec"] / peak_flops, 4
+        )
+    if peak_bandwidth and total_s:
+        out["bandwidth_utilization"] = round(
+            out["achieved_bytes_per_sec"] / peak_bandwidth, 4
+        )
+    return out

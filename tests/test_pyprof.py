@@ -153,3 +153,29 @@ def test_prof_class_report(tmp_path):
     assert abs(sum(r["pct"] for r in classes) - 100.0) < 1e-6
     table = prof_table(classes)
     assert "time by kind" in table and "class" in table
+
+
+def test_utilization_report(tmp_path):
+    """trace -> prof -> utilization with cost analysis: the reference
+    prof stage's FLOPs/efficiency columns (apex/pyprof/prof/)."""
+    from apex_tpu.pyprof import cost_analysis, parse, prof, trace, utilization
+
+    @jax.jit
+    def step(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    x = jnp.ones((256, 256))
+    w = jnp.ones((256, 256))
+    jax.block_until_ready(step(x, w))
+    log_dir = str(tmp_path / "trace")
+    steps = 4
+    with trace(log_dir):
+        for _ in range(steps):
+            jax.block_until_ready(step(x, w))
+    classes = prof(parse(log_dir))
+    costs = cost_analysis(step, x, w)
+    rep = utilization(classes, costs, peak_flops=1e12, steps=steps)
+    assert rep["flops"] >= 2 * 256**3 * 0.9
+    assert rep["compute_ms"] >= 0 and rep["achieved_flops_per_sec"] >= 0
+    if rep["compute_ms"] > 0:
+        assert "compute_utilization" in rep

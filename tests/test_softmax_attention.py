@@ -479,10 +479,14 @@ class TestFp32DispatchWindow:
 
     def test_fp32_short_seq_auto_routes_to_xla(self, monkeypatch):
         attn_mod, calls = self._spy(monkeypatch)
-        s = attn_mod.FLASH_FP32_XLA_MAX_SEQ
         q = jnp.ones((1, 1, 8, 8), jnp.float32)
         attn_mod.flash_attention(q, q, q, implementation=None)
         assert calls == []  # window fired: no pallas attempt
+        # inclusive boundary: seq == FLASH_FP32_XLA_MAX_SEQ also routes
+        s = attn_mod.FLASH_FP32_XLA_MAX_SEQ
+        qb = jnp.ones((1, 1, s, 8), jnp.float32)
+        attn_mod.flash_attention(qb, qb, qb, implementation=None)
+        assert calls == []
 
     def test_bf16_and_explicit_fp32_still_hit_pallas(self, monkeypatch):
         attn_mod, calls = self._spy(monkeypatch)
