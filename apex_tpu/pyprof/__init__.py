@@ -107,9 +107,10 @@ def summarize(fn: Callable, *args, peak_flops: Optional[float] = None,
     """FLOPs / bytes / arithmetic-intensity report (the reference's
     ``prof`` output: per-op efficiency tables, apex/pyprof/prof/).  With
     ``peak_*`` given, adds roofline utilization bounds."""
+    from apex_tpu.pyprof.prof import _cost_numbers
+
     costs = cost_analysis(fn, *args, **kwargs)
-    flops = float(costs.get("flops", 0.0))
-    bytes_accessed = float(costs.get("bytes accessed", 0.0))
+    flops, bytes_accessed = _cost_numbers(costs)
     out = {
         "flops": flops,
         "bytes_accessed": bytes_accessed,

@@ -57,8 +57,12 @@ OP_CLASSES = (
     ("copy_layout", r"copy|transpose|reshape|bitcast|broadcast|concat|"
      r"reverse|tuple|convert", "memory"),
     ("select_compare", r"select|compare|clamp|where|iota", "memory"),
-    ("elementwise", r"add|sub|mul|div|exp|log|tanh|sqrt|rsqrt|pow|neg|abs|"
-     r"max|min|and|or|xor|not|sin|cos|floor|ceil|sign|remainder", "memory"),
+    # opcode tokens anchor at a word START (matching "multiply",
+    # "exponential"); a bare "or" substring would swallow host thread
+    # names like "ThunkExecutor::Execute"
+    ("elementwise",
+     r"\b(add|sub|mul|div|exp|log|tanh|sqrt|rsqrt|pow|neg|abs|max|min|"
+     r"and|or\b|xor|not|sin|cos|floor|ceil|sign|remainder)", "memory"),
     ("fusion", r"fusion|\bcall\b", "compute"),
 )
 
@@ -125,6 +129,16 @@ def prof(
     return out
 
 
+def _cost_numbers(costs: Dict[str, float]) -> tuple:
+    """(flops, bytes accessed) from an XLA cost-analysis dict — shared
+    with :func:`apex_tpu.pyprof.summarize` so a cost-key rename cannot
+    silently zero one of the two call sites."""
+    return (
+        float(costs.get("flops", 0.0)),
+        float(costs.get("bytes accessed", 0.0)),
+    )
+
+
 def _time_by_kind(classes: List[Dict[str, Any]]) -> Dict[str, float]:
     by_kind: Dict[str, float] = {}
     for r in classes:
@@ -175,12 +189,17 @@ def utilization(
     by_kind = _time_by_kind(classes)
     compute_s = by_kind.get("compute", 0.0) / 1e3 / max(steps, 1)
     memory_s = by_kind.get("memory", 0.0) / 1e3 / max(steps, 1)
-    # bandwidth follows the roofline convention: bytes over TOTAL step
-    # time (compute-class ops move most of the HBM bytes; dividing by
-    # memory-class time alone would inflate past 1.0)
-    total_s = sum(by_kind.values()) / 1e3 / max(steps, 1)
-    flops = float(costs.get("flops", 0.0))
-    bytes_accessed = float(costs.get("bytes accessed", 0.0))
+    # bandwidth follows the roofline convention: bytes over total DEVICE
+    # time (compute + memory + collective — compute-class ops move most
+    # of the HBM bytes; dividing by memory-class time alone would
+    # inflate past 1.0, and folding host/other time in would deflate it)
+    total_s = sum(
+        by_kind.get(k, 0.0) for k in ("compute", "memory", "collective")
+    ) / 1e3 / max(steps, 1)
+    other_s = (
+        sum(by_kind.values()) / 1e3 / max(steps, 1) - total_s
+    )
+    flops, bytes_accessed = _cost_numbers(costs)
     out: Dict[str, Any] = {
         "compute_ms": round(compute_s * 1e3, 3),
         "memory_ms": round(memory_s * 1e3, 3),
@@ -188,6 +207,7 @@ def utilization(
             by_kind.get("collective", 0.0) / max(steps, 1), 3
         ),
         "total_ms": round(total_s * 1e3, 3),
+        "host_other_ms": round(other_s * 1e3, 3),
         "flops": flops,
         "bytes_accessed": bytes_accessed,
         "achieved_flops_per_sec": flops / compute_s if compute_s else 0.0,
