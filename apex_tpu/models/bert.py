@@ -253,6 +253,26 @@ class BertModel:
         y = self.fc2.apply(lp["fc2"], y)
         return residual + y.astype(residual.dtype)
 
+    def _embed(self, params, tokens, tokentype_ids=None) -> jnp.ndarray:
+        """word + position (+ tokentype) embedding sum in compute dtype —
+        one definition shared by the sequential and pipeline paths."""
+        c = self.config
+        s = tokens.shape[1]
+        x = self.embedding.apply(params["embedding"], tokens)
+        x = x + params["pos_embedding"][:s][None].astype(x.dtype)
+        if tokentype_ids is not None:
+            x = x + jnp.take(
+                params["tokentype_embedding"], tokentype_ids, axis=0
+            ).astype(x.dtype)
+        return x.astype(c.compute_dtype)
+
+    @staticmethod
+    def _kv_segments(attention_mask) -> jnp.ndarray:
+        """keep-tokens form segment 0; masked keys get a sentinel that
+        never matches a query segment, so they are excluded exactly like
+        the reference's additive -inf mask."""
+        return jnp.where(attention_mask, 0, -2).astype(jnp.int32)
+
     def encode(
         self,
         params: Dict[str, Any],
@@ -263,23 +283,12 @@ class BertModel:
         """tokens (b, s); attention_mask (b, s) True=keep.  Returns
         (b, s, h) final-layernormed hidden states."""
         c = self.config
-        b, s = tokens.shape
-        x = self.embedding.apply(params["embedding"], tokens)
-        x = x + params["pos_embedding"][:s][None].astype(x.dtype)
-        if tokentype_ids is not None:
-            x = x + jnp.take(
-                params["tokentype_embedding"], tokentype_ids, axis=0
-            ).astype(x.dtype)
-        x = x.astype(c.compute_dtype)
+        x = self._embed(params, tokens, tokentype_ids)
 
         segs = None
         if attention_mask is not None:
-            # keep-tokens form segment 0; masked keys get a sentinel that
-            # never matches a query segment, so they are excluded exactly
-            # like the reference's additive -inf mask
-            kv_seg = jnp.where(attention_mask, 0, -2).astype(jnp.int32)
-            q_seg = jnp.zeros_like(kv_seg)
-            segs = (q_seg, kv_seg)
+            kv_seg = self._kv_segments(attention_mask)
+            segs = (jnp.zeros_like(kv_seg), kv_seg)
 
         def body(carry, lp):
             return self._layer(lp, carry, segs), None
@@ -382,4 +391,118 @@ class BertModel:
                 jnp.take_along_axis(logp, binary_labels[:, None], 1)[:, 0]
             )
             loss = loss + jax.lax.pmean(sop, DATA_PARALLEL_AXIS)
+        return loss
+
+    # ------------------------------------------------------ pipeline path
+    def pipeline_param_specs(self) -> Dict[str, Any]:
+        """Param specs with the stacked-layer dim sharded over "pp"
+        (same contract as GPT/T5)."""
+        from apex_tpu.transformer.pipeline_parallel import (
+            pipeline_stage_specs,
+        )
+
+        specs = self.param_specs()
+        specs["layers"] = pipeline_stage_specs(specs["layers"])
+        return specs
+
+    def pipeline_loss(
+        self,
+        params: Dict[str, Any],
+        tokens: jnp.ndarray,
+        lm_labels: jnp.ndarray,
+        loss_mask: jnp.ndarray,
+        num_microbatches: int,
+        attention_mask: Optional[jnp.ndarray] = None,
+        binary_labels: Optional[jnp.ndarray] = None,
+        tokentype_ids: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """Masked-LM (+ binary) loss through the compiled pipeline
+        schedule (reference: run_bert_minimal_test.py drives the
+        standalone BERT through the pipeline schedules).  Same placement
+        contract as :meth:`pipeline_param_specs`.  The padding mask
+        rides the carried state as segment ids; the masked-mean's
+        numerator/denominator ride the per-microbatch result vector so
+        the global mean weights every masked position equally."""
+        from apex_tpu.transformer.pipeline_parallel import pipeline
+
+        c = self.config
+        b, s = tokens.shape
+        if b % num_microbatches:
+            raise ValueError(
+                f"local batch ({b}) must be divisible by "
+                f"num_microbatches ({num_microbatches})"
+            )
+        mb = b // num_microbatches
+
+        def shard(x):
+            return (
+                None if x is None
+                else x.reshape(num_microbatches, mb, *x.shape[1:])
+            )
+
+        mbs = {
+            "tokens": shard(tokens),
+            "lm_labels": shard(lm_labels),
+            "loss_mask": shard(loss_mask),
+        }
+        if attention_mask is not None:
+            mbs["attention_mask"] = shard(attention_mask)
+        if tokentype_ids is not None:
+            mbs["tokentype_ids"] = shard(tokentype_ids)
+        use_binary = c.add_binary_head and binary_labels is not None
+        if use_binary:
+            mbs["binary_labels"] = shard(binary_labels)
+
+        def first_fn(m):
+            state = {"x": self._embed(
+                params, m["tokens"], m.get("tokentype_ids")
+            )}
+            if "attention_mask" in m:
+                state["kv_seg"] = self._kv_segments(m["attention_mask"])
+            return state
+
+        def stage_fn(state):
+            segs = None
+            if "kv_seg" in state:
+                segs = (jnp.zeros_like(state["kv_seg"]), state["kv_seg"])
+
+            def body(carry, lp):
+                return self._layer(lp, carry, segs), None
+
+            out, _ = jax.lax.scan(body, state["x"], params["layers"])
+            return {**state, "x": out}
+
+        def last_fn(state, m):
+            x = fused_layer_norm_affine(
+                state["x"].astype(jnp.float32),
+                params["final_ln"]["scale"], params["final_ln"]["bias"],
+                (c.hidden_size,), eps=c.layernorm_epsilon,
+            ).astype(c.compute_dtype)
+            per_token = self._per_token_ce(params, x, m["lm_labels"])
+            mask = m["loss_mask"].astype(jnp.float32)
+            num = jnp.sum(per_token * mask)
+            den = jnp.sum(mask)
+            if use_binary:
+                logp = jax.nn.log_softmax(
+                    self.binary_logits(params, x), axis=-1
+                )
+                sop_num = -jnp.sum(jnp.take_along_axis(
+                    logp, m["binary_labels"][:, None], 1
+                )[:, 0])
+                rows = jnp.float32(mb)
+            else:
+                sop_num = jnp.float32(0.0)
+                rows = jnp.float32(0.0)
+            return jnp.stack([num, den, sop_num, rows])
+
+        per = pipeline(first_fn, stage_fn, last_fn, mbs, remat=c.remat)
+        num, den, sop_num, rows = per.sum(axis=0)
+        loss = jax.lax.psum(num, DATA_PARALLEL_AXIS) / jnp.maximum(
+            jax.lax.psum(den, DATA_PARALLEL_AXIS), 1.0
+        )
+        if use_binary:
+            loss = loss + (
+                jax.lax.psum(sop_num, DATA_PARALLEL_AXIS)
+                / jnp.maximum(jax.lax.psum(rows, DATA_PARALLEL_AXIS), 1.0)
+            )
         return loss

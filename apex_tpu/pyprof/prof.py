@@ -57,12 +57,14 @@ OP_CLASSES = (
     ("copy_layout", r"copy|transpose|reshape|bitcast|broadcast|concat|"
      r"reverse|tuple|convert", "memory"),
     ("select_compare", r"select|compare|clamp|where|iota", "memory"),
-    # opcode tokens anchor at a word START (matching "multiply",
-    # "exponential"); a bare "or" substring would swallow host thread
-    # names like "ThunkExecutor::Execute"
+    # long opcode forms listed explicitly; short/collision-prone tokens
+    # are fully word-bounded so host frames ("ThunkExecutor::Execute",
+    # "absl::Mutex", "Notification") never misfile as device work
     ("elementwise",
-     r"\b(add|sub|mul|div|exp|log|tanh|sqrt|rsqrt|pow|neg|abs|max|min|"
-     r"and|or\b|xor|not|sin|cos|floor|ceil|sign|remainder)", "memory"),
+     r"multiply|divide|exponential|logarithm|subtract|negate|maximum|"
+     r"minimum|remainder|rsqrt|sqrt|tanh|floor|ceil|"
+     r"\b(add|sub|mul|div|exp|log|pow|neg|abs|max|min|and|or|xor|not|"
+     r"sin|cos|sign)\b", "memory"),
     ("fusion", r"fusion|\bcall\b", "compute"),
 )
 

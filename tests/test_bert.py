@@ -132,3 +132,70 @@ def test_bert_without_binary_head():
         assert lm.shape == (8, 12, 64)
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def test_bert_pipeline_matches_sequential():
+    """pp=2 x tp=2 x dp=2 BERT pipeline loss+grads == the sequential
+    loss (reference: run_bert_minimal_test.py pipeline tier)."""
+    from apex_tpu.transformer.pipeline_parallel import sync_replicated_grads
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2, pipeline_model_parallel_size_=2
+    )
+    try:
+        cfg = small_config()
+        model = BertModel(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        ks = jax.random.split(jax.random.PRNGKey(7), 5)
+        tokens = jax.random.randint(ks[0], (8, 12), 0, cfg.vocab_size)
+        labels = jax.random.randint(ks[1], (8, 12), 0, cfg.vocab_size)
+        loss_mask = (jax.random.uniform(ks[2], (8, 12)) < 0.4).astype(
+            jnp.float32)
+        attn_mask = jax.random.uniform(ks[3], (8, 12)) < 0.9
+        bin_labels = jax.random.randint(ks[4], (8,), 0, 2)
+
+        seq_specs = model.param_specs()
+        seq_loss = jax.jit(jax.shard_map(
+            lambda p, t, l, m, a, b: model.loss(
+                p, t, l, m, attention_mask=a, binary_labels=b),
+            mesh=mesh,
+            in_specs=(seq_specs,) + (P("dp"),) * 5,
+            out_specs=P(),
+        ))
+
+        def place(tree, sp):
+            return jax.device_put(tree, jax.tree.map(
+                lambda s: NamedSharding(mesh, s), sp,
+                is_leaf=lambda x: isinstance(x, P)))
+
+        expected = float(seq_loss(
+            place(params, seq_specs), tokens, labels, loss_mask,
+            attn_mask, bin_labels,
+        ))
+
+        pp_specs = model.pipeline_param_specs()
+
+        def pp_fn(p, t, l, m, a, b):
+            loss, grads = jax.value_and_grad(
+                lambda pp_: model.pipeline_loss(
+                    pp_, t, l, m, 2, attention_mask=a, binary_labels=b)
+            )(p)
+            grads = sync_replicated_grads(grads, pp_specs)
+            return loss, grads
+
+        grad_fn = jax.jit(jax.shard_map(
+            pp_fn, mesh=mesh,
+            in_specs=(pp_specs,) + (P("dp"),) * 5,
+            out_specs=(P(), pp_specs),
+        ))
+        loss, grads = grad_fn(
+            place(params, pp_specs), tokens, labels, loss_mask,
+            attn_mask, bin_labels,
+        )
+        np.testing.assert_allclose(float(loss), expected, rtol=2e-5)
+        finite = all(
+            bool(jnp.all(jnp.isfinite(g))) for g in jax.tree.leaves(grads)
+        )
+        assert finite
+    finally:
+        parallel_state.destroy_model_parallel()
