@@ -266,6 +266,16 @@ class BertModel:
             ).astype(x.dtype)
         return x.astype(c.compute_dtype)
 
+    def _final_ln(self, params, x) -> jnp.ndarray:
+        """Final encoder layernorm (fp32 math, compute-dtype out) — one
+        definition shared by the sequential and pipeline paths."""
+        c = self.config
+        return fused_layer_norm_affine(
+            x.astype(jnp.float32),
+            params["final_ln"]["scale"], params["final_ln"]["bias"],
+            (c.hidden_size,), eps=c.layernorm_epsilon,
+        ).astype(c.compute_dtype)
+
     @staticmethod
     def _kv_segments(attention_mask) -> jnp.ndarray:
         """keep-tokens form segment 0; masked keys get a sentinel that
@@ -299,12 +309,7 @@ class BertModel:
 
             scan_body = checkpoint(body, policy=c.remat_policy)
         x, _ = jax.lax.scan(scan_body, x, params["layers"])
-        x = fused_layer_norm_affine(
-            x.astype(jnp.float32),
-            params["final_ln"]["scale"], params["final_ln"]["bias"],
-            (c.hidden_size,), eps=c.layernorm_epsilon,
-        )
-        return x.astype(c.compute_dtype)
+        return self._final_ln(params, x)
 
     def mlm_hidden(self, params, hidden) -> jnp.ndarray:
         """MLM head transform (dense + GELU + LN) before the tied vocab
@@ -473,11 +478,7 @@ class BertModel:
             return {**state, "x": out}
 
         def last_fn(state, m):
-            x = fused_layer_norm_affine(
-                state["x"].astype(jnp.float32),
-                params["final_ln"]["scale"], params["final_ln"]["bias"],
-                (c.hidden_size,), eps=c.layernorm_epsilon,
-            ).astype(c.compute_dtype)
+            x = self._final_ln(params, state["x"])
             per_token = self._per_token_ce(params, x, m["lm_labels"])
             mask = m["loss_mask"].astype(jnp.float32)
             num = jnp.sum(per_token * mask)

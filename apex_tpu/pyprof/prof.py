@@ -62,7 +62,8 @@ OP_CLASSES = (
     # "absl::Mutex", "Notification") never misfile as device work
     ("elementwise",
      r"multiply|divide|exponential|logarithm|subtract|negate|maximum|"
-     r"minimum|remainder|rsqrt|sqrt|tanh|floor|ceil|"
+     r"minimum|remainder|rsqrt|sqrt|tanh|floor|ceil|sine|cosine|power|"
+     r"logistic|sigmoid|gelu|relu|erf\b|"
      r"\b(add|sub|mul|div|exp|log|pow|neg|abs|max|min|and|or|xor|not|"
      r"sin|cos|sign)\b", "memory"),
     ("fusion", r"fusion|\bcall\b", "compute"),
