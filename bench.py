@@ -25,22 +25,83 @@ BENCH_EXTRA.json + stderr, keeping stdout a single line.
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
-PROBE_TIMEOUT = int(os.environ.get("APEX_BENCH_PROBE_TIMEOUT", "180"))
+PROBE_TIMEOUT = int(os.environ.get("APEX_BENCH_PROBE_TIMEOUT", "120"))
 CHILD_TIMEOUT = int(os.environ.get("APEX_BENCH_CHILD_TIMEOUT", "1200"))
 TOTAL_BUDGET = int(os.environ.get("APEX_BENCH_TOTAL_BUDGET", "3000"))
-RETRIES = int(os.environ.get("APEX_BENCH_RETRIES", "3"))
-BACKOFF = [15, 45, 90]
+# Time reserved after a successful probe for the actual measurement
+# (TPU gpt child + a slice for extras); the probe loop may consume
+# everything before this point.  The axon chip-claim wedge can last
+# >1h, so probing briefly and giving up (the round-3 failure: 3x180s)
+# wastes the whole gate — instead probe with backoff until only the
+# reserve is left.
+MEASURE_RESERVE = int(os.environ.get("APEX_BENCH_MEASURE_RESERVE", "1500"))
+# Persisted record of the last successful TPU-captured bench, so a
+# flaky tunnel at gate time cannot erase hardware evidence: the CPU
+# fallback output carries this forward as `last_tpu_result`.
+LAST_TPU_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "LAST_TPU_BENCH.json"
+)
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _git_sha():
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip()[:12]
+    except Exception:
+        return "unknown"
+
+
+def _save_last_tpu(result, extras=None):
+    try:
+        rec = {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "git_sha": _git_sha(), "result": result}
+        if extras is not None:
+            rec["extras"] = extras
+        elif os.path.exists(LAST_TPU_PATH):
+            # keep previously captured extras if this run didn't get any
+            try:
+                with open(LAST_TPU_PATH) as f:
+                    old = json.load(f)
+                if "extras" in old:
+                    rec["extras"] = old["extras"]
+                    rec["extras_captured_at"] = old.get(
+                        "extras_captured_at", old.get("captured_at"))
+            except Exception:
+                pass
+        with open(LAST_TPU_PATH, "w") as f:
+            json.dump(rec, f, indent=1)
+    except OSError as e:
+        log(f"last-tpu record write failed: {e}")
+
+
+def _load_last_tpu():
+    try:
+        with open(LAST_TPU_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
 # --------------------------------------------------------------------- child
+def _install_sigterm_exit():
+    """Let a child exit cleanly on SIGTERM so the JAX client tears down
+    and releases the chip claim (a hard kill wedges the axon pool's
+    single-chip grant for >1h — observed round 3)."""
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
+
 def _pin_cpu():
     import jax
 
@@ -630,18 +691,37 @@ def _t5_extra(out, on_tpu):
 
 # ---------------------------------------------------------------- orchestrator
 def _run_child(args, timeout):
-    """Run `python bench.py <args>` bounded; return (ok, last_json, tail)."""
+    """Run `python bench.py <args>` bounded; return (ok, last_json, tail).
+
+    Timeout handling is SIGTERM-first with a long grace period, NEVER an
+    immediate SIGKILL: a child holding the TPU claim that dies without
+    client teardown wedges the axon pool's single-chip grant for >1h
+    (round-3 post-mortem).  SIGTERM hits the child's clean-exit handler
+    (`_install_sigterm_exit`); SIGKILL only after the grace expires.
+    """
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    timed_out = False
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)] + args,
-            capture_output=True, text=True, timeout=timeout,
-        )
+        out, errtxt = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.terminate()  # SIGTERM -> child's clean-exit handler
+        try:
+            out, errtxt = proc.communicate(timeout=45)
+        except subprocess.TimeoutExpired:
+            log("child ignored SIGTERM for 45s; escalating to SIGKILL "
+                "(chip claim may wedge)")
+            proc.kill()
+            out, errtxt = proc.communicate()
+    sys.stderr.write((errtxt or "")[-4000:])
+    if timed_out:
         return False, None, f"timeout after {timeout}s"
-    sys.stderr.write(proc.stderr[-4000:])
     if proc.returncode != 0:
-        return False, None, (proc.stderr or "")[-1500:]
-    for line in reversed(proc.stdout.strip().splitlines()):
+        return False, None, (errtxt or "")[-1500:]
+    for line in reversed((out or "").strip().splitlines()):
         try:
             return True, json.loads(line), ""
         except json.JSONDecodeError:
@@ -653,29 +733,54 @@ def main():
     t_start = time.perf_counter()
     errors = []
 
+    def budget_left():
+        return TOTAL_BUDGET - (time.perf_counter() - t_start)
+
+    # Probe with exponential backoff until only the measurement reserve
+    # is left.  The axon chip-claim wedge outlives any fixed small retry
+    # count; a single late success is worth far more than extras, so the
+    # probe window is everything the measurement doesn't need.
     platform = None
-    for attempt in range(RETRIES):
-        ok, probe, err = _run_child(["--child", "probe"], PROBE_TIMEOUT)
+    backoff = 20
+    attempt = 0
+    while budget_left() > MEASURE_RESERVE:
+        ok, probe, err = _run_child(
+            ["--child", "probe"],
+            min(PROBE_TIMEOUT, max(30, budget_left() - MEASURE_RESERVE)),
+        )
         if ok:
             platform = probe["platform"]
             log(f"probe: {probe}")
             break
-        errors.append(f"probe[{attempt}]: {err.strip().splitlines()[-1] if err.strip() else err}")
+        tail = err.strip().splitlines()[-1] if err.strip() else err
+        errors.append(f"probe[{attempt}]: {tail}")
         log(f"probe attempt {attempt} failed: {err[-300:]}")
-        if attempt < RETRIES - 1:
-            time.sleep(BACKOFF[min(attempt, len(BACKOFF) - 1)])
+        attempt += 1
+        sleep_for = min(backoff, max(0, budget_left() - MEASURE_RESERVE))
+        if sleep_for <= 0:
+            break
+        log(f"probe backoff: sleeping {sleep_for:.0f}s "
+            f"({budget_left():.0f}s budget left)")
+        time.sleep(sleep_for)
+        backoff = min(backoff * 2, 600)
+    if platform is None:
+        errors.append(
+            f"probe gave up after {attempt} attempts / "
+            f"{time.perf_counter() - t_start:.0f}s (reserve {MEASURE_RESERVE}s)")
 
     result = None
+    on_tpu = False
     if platform is not None and platform != "cpu":
-        for attempt in range(2):
+        for retry in range(2):
             ok, result, err = _run_child(
                 ["--child", "gpt", "--platform", platform], CHILD_TIMEOUT
             )
             if ok:
+                on_tpu = True
                 break
-            errors.append(f"tpu-gpt[{attempt}]: {err[-300:]}")
+            errors.append(f"tpu-gpt[{retry}]: {err[-300:]}")
             result = None
-            if attempt == 0:
+            if retry == 0:
                 time.sleep(30)
 
     if result is None:
@@ -686,25 +791,32 @@ def main():
         )
         if not ok:
             errors.append(f"cpu-gpt: {err[-300:]}")
-            print(json.dumps({
+            result = {
                 "metric": "gpt_tp1_tokens_per_sec",
                 "value": 0.0,
                 "unit": "tokens/s",
                 "vs_baseline": 0.0,
                 "error": "; ".join(errors)[-800:],
-            }))
+            }
+            last = _load_last_tpu()
+            if last:
+                result["last_tpu_result"] = last
+            print(json.dumps(result))
             return
 
     # extra BASELINE.md targets — never allowed to break the main metric
-    budget_left = TOTAL_BUDGET - (time.perf_counter() - t_start)
-    if budget_left <= 300:
-        log(f"skipping extras: only {budget_left:.0f}s of total budget left")
-    if budget_left > 300:
+    extras = None
+    if budget_left() <= 300:
+        log(f"skipping extras: only {budget_left():.0f}s of total budget left")
+    else:
         ok, extras, err = _run_child(
             ["--child", "extras", "--platform", result.get("platform", "cpu")],
-            min(budget_left, CHILD_TIMEOUT),
+            min(budget_left(), CHILD_TIMEOUT),
         )
-        if ok:
+        if not ok:
+            extras = None
+            log(f"extras failed (non-fatal): {err[-300:]}")
+        else:
             try:
                 with open(os.path.join(
                     os.path.dirname(os.path.abspath(__file__)),
@@ -714,9 +826,16 @@ def main():
             except OSError as e:
                 log(f"extras write failed: {e}")
             log(f"extras: {extras}")
-        else:
-            log(f"extras failed (non-fatal): {err[-300:]}")
 
+    if on_tpu:
+        _save_last_tpu(result, extras if (extras or {}).get("platform") != "cpu"
+                       else None)
+    else:
+        # hardware evidence survives a flaky tunnel: attach the last
+        # TPU-captured record (timestamp + git sha) to the fallback
+        last = _load_last_tpu()
+        if last:
+            result["last_tpu_result"] = last
     if errors:
         prior = result.get("note", "")
         result["note"] = (prior + "; " if prior else "") + "; ".join(errors)[-500:]
@@ -725,6 +844,7 @@ def main():
 
 if __name__ == "__main__":
     if "--child" in sys.argv:
+        _install_sigterm_exit()
         kind = sys.argv[sys.argv.index("--child") + 1]
         plat = (
             sys.argv[sys.argv.index("--platform") + 1]
