@@ -8,52 +8,230 @@ the TPU-native design is functional: masks are a pytree computed from
 params, applied with a tree-map, and optimizer integration is a wrapper
 that re-masks after each step — no in-place mutation, jit-fusable into
 the train step.
+
+Pattern library (reference: sparse_masklib.py):
+
+- ``m4n2_1d``    — best 2-of-4 along the last dim, chosen by magnitude
+  over all C(4,2)=6 valid group patterns (``mn_1d_best``).
+- ``m4n2_2d_best`` — exhaustive best over the 90 valid 4x4 block
+  patterns that are 2:4 along BOTH rows and columns (``mn_2d_best``) —
+  the transposed weight stays 2:4, the property the reference uses to
+  accelerate DGRAD.
+- ``m4n2_2d_greedy`` — the reference's greedy per-block selection
+  (``mn_2d_greedy``), vectorised over blocks with a scan instead of the
+  reference's per-block Python loops.
+
+All calculators are pure jax and jittable; the pattern tables are tiny
+static numpy constants built once at import/trace time.
+
+TPU note: TPUs have no sparse-MXU analog of Ampere's SpMMA, so ASP here
+buys memory (masked weights compress) and regularisation parity, not a
+matmul speedup.  The mask math is identical; only the hardware payoff
+differs.
 """
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["create_mask", "ASP"]
+__all__ = [
+    "create_mask",
+    "mn_1d_best",
+    "mn_2d_best",
+    "mn_2d_greedy",
+    "ASP",
+    "prune_trained_model",
+]
 
 
-def _m4n2_1d(w2d: jnp.ndarray) -> jnp.ndarray:
-    """Keep the 2 largest-|w| of every contiguous group of 4 along the
-    last dim (reference: sparse_masklib.py ``mn_1d_best``/``m4n2_1d``)."""
-    rows, cols = w2d.shape
-    if cols % 4:
+# ------------------------------------------------------------- pattern tables
+def _valid_1d_patterns(m: int, n: int) -> np.ndarray:
+    """All m-length 0/1 vectors with exactly n ones
+    (reference: sparse_masklib.compute_valid_1d_patterns — which
+    enumerates m! permutations; C(m,n) combinations give the same table
+    without the factorial blow-up at larger m)."""
+    combos = list(itertools.combinations(range(m), n))
+    pats = np.zeros((len(combos), m), np.float32)
+    for i, keep in enumerate(combos):
+        pats[i, list(keep)] = 1.0
+    return pats
+
+
+def _valid_2d_patterns(m: int, n: int) -> np.ndarray:
+    """All m x m 0/1 blocks whose every row has exactly n ones and every
+    column at most n (reference: compute_valid_2d_patterns — for m=4,n=2
+    column sums are then exactly 2, giving 90 doubly-2:4 patterns)."""
+    if m > 5:
         raise ValueError(
-            f"2:4 sparsity needs a multiple-of-4 inner dim, got {cols}"
-        )
-    g = jnp.abs(w2d).reshape(rows, cols // 4, 4)
-    # rank within each group; keep the top 2
-    order = jnp.argsort(g, axis=-1)  # ascending
-    ranks = jnp.argsort(order, axis=-1)
-    mask = ranks >= 2
-    return mask.reshape(rows, cols)
+            f"2d pattern enumeration is C(m,n)^m and impractical for m={m}; "
+            "use mn_2d_greedy for larger blocks")
+    rows = _valid_1d_patterns(m, n)
+    valid = []
+    for combo in itertools.product(range(len(rows)), repeat=m):
+        block = rows[list(combo)]
+        if (block.sum(axis=0) <= n).all():
+            valid.append(block)
+    return np.stack(valid)  # (P, m, m)
 
 
-_PATTERNS = {"m4n2_1d": _m4n2_1d}
+_PATTERN_CACHE: dict = {}
+
+
+def _patterns_1d(m: int, n: int) -> np.ndarray:
+    key = ("1d", m, n)
+    if key not in _PATTERN_CACHE:
+        _PATTERN_CACHE[key] = _valid_1d_patterns(m, n)
+    return _PATTERN_CACHE[key]
+
+
+def _patterns_2d(m: int, n: int) -> np.ndarray:
+    key = ("2d", m, n)
+    if key not in _PATTERN_CACHE:
+        _PATTERN_CACHE[key] = _valid_2d_patterns(m, n)
+    return _PATTERN_CACHE[key]
+
+
+# ---------------------------------------------------------- mask calculators
+def mn_1d_best(w2d: jnp.ndarray, m: int = 4, n: int = 2) -> jnp.ndarray:
+    """Best n-of-m keep-mask along the last dim by kept |w| magnitude
+    (reference: sparse_masklib.mn_1d_best — argmax over the pattern
+    score matrix |w| @ P^T, which for exact-n patterns IS the top-n
+    choice, computed the MXU-friendly way)."""
+    rows, cols = w2d.shape
+    if cols % m:
+        raise ValueError(f"{n}:{m} sparsity needs a multiple-of-{m} inner dim, got {cols}")
+    pats = jnp.asarray(_patterns_1d(m, n))  # (P, m)
+    g = jnp.abs(w2d.astype(jnp.float32)).reshape(-1, m)
+    best = jnp.argmax(g @ pats.T, axis=-1)  # (rows*cols/m,)
+    return pats[best].reshape(rows, cols).astype(bool)
+
+
+def mn_2d_best(w2d: jnp.ndarray, m: int = 4, n: int = 2) -> jnp.ndarray:
+    """Exhaustive best m x m block mask that keeps the weight n:m sparse
+    along BOTH rows and columns (reference: sparse_masklib.mn_2d_best).
+    Scores all valid block patterns at once with one einsum (MXU) and
+    gathers the argmax pattern per block."""
+    rows, cols = w2d.shape
+    if rows % m or cols % m:
+        raise ValueError(f"2d {n}:{m} sparsity needs multiple-of-{m} dims, got {w2d.shape}")
+    pats = jnp.asarray(_patterns_2d(m, n))  # (P, m, m)
+    blocks = jnp.abs(
+        w2d.astype(jnp.float32)
+        .reshape(rows // m, m, cols // m, m)
+        .transpose(0, 2, 1, 3)
+    )  # (R, C, m, m)
+    scores = jnp.einsum("rcij,pij->rcp", blocks, pats)
+    best = jnp.argmax(scores, axis=-1)  # (R, C)
+    mask = pats[best]  # (R, C, m, m)
+    return (
+        mask.transpose(0, 2, 1, 3).reshape(rows, cols).astype(bool)
+    )
+
+
+def mn_2d_greedy(w2d: jnp.ndarray, m: int = 4, n: int = 2) -> jnp.ndarray:
+    """Greedy per-block doubly-n:m mask (reference:
+    sparse_masklib.mn_2d_greedy): walk each block's entries in
+    descending |w| order, keeping an entry unless its row or column
+    already holds n kept entries.  The reference loops per block on the
+    host; here one ``lax.scan`` over the sorted positions runs every
+    block in parallel (trailing blocks when dims don't divide by m are
+    left dense, matching the reference's rowCount/colCount cropping).
+
+    Like the reference greedy, this can keep FEWER than n entries in a
+    row/column when the only remaining candidates sit in already-full
+    lines (kept count per line is ≤ n, not always == n); use
+    ``mn_2d_best`` when exact doubly-n:m structure is required."""
+    rows, cols = w2d.shape
+    R, C = rows // m, cols // m
+    if R == 0 or C == 0:
+        return jnp.ones((rows, cols), bool)
+    crop = jnp.abs(
+        w2d[: R * m, : C * m]
+        .astype(jnp.float32)
+        .reshape(R, m, C, m)
+        .transpose(0, 2, 1, 3)
+    ).reshape(R * C, m * m)
+    order = jnp.argsort(-crop, axis=-1)  # descending positions, (B, m*m)
+
+    def pick(carry, idx):
+        keep, rcnt, ccnt = carry  # (B, m*m), (B, m), (B, m)
+        r, c = idx // m, idx % m
+        b = jnp.arange(keep.shape[0])
+        ok = (rcnt[b, r] < n) & (ccnt[b, c] < n)
+        keep = keep.at[b, idx].set(ok)
+        rcnt = rcnt.at[b, r].add(ok.astype(rcnt.dtype))
+        ccnt = ccnt.at[b, c].add(ok.astype(ccnt.dtype))
+        return (keep, rcnt, ccnt), None
+
+    B = R * C
+    init = (
+        jnp.zeros((B, m * m), bool),
+        jnp.zeros((B, m), jnp.int32),
+        jnp.zeros((B, m), jnp.int32),
+    )
+    (keep, _, _), _ = jax.lax.scan(pick, init, order.T)
+    block_mask = keep.reshape(R, C, m, m).transpose(0, 2, 1, 3).reshape(R * m, C * m)
+    mask = jnp.ones((rows, cols), bool)
+    return mask.at[: R * m, : C * m].set(block_mask)
+
+
+def _m4n2_1d(w2d):
+    return mn_1d_best(w2d, 4, 2)
+
+
+def _m4n2_2d_best(w2d):
+    return mn_2d_best(w2d, 4, 2)
+
+
+def _m4n2_2d_greedy(w2d):
+    return mn_2d_greedy(w2d, 4, 2)
+
+
+_PATTERNS = {
+    "m4n2_1d": _m4n2_1d,
+    "m4n2_2d_best": _m4n2_2d_best,
+    "m4n2_2d_greedy": _m4n2_2d_greedy,
+}
 
 
 def create_mask(w: jnp.ndarray, pattern: str = "m4n2_1d") -> jnp.ndarray:
     """Boolean keep-mask with the requested structured pattern
-    (reference: sparse_masklib.create_mask)."""
+    (reference: sparse_masklib.create_mask, which routes 1-4d tensors
+    into the 2d calculators).  nd handling: 1-3d collapse leading dims
+    onto rows; 4d assumes the JAX conv layout HWIO and prunes along the
+    input-channel axis, the analog of the reference pruning its OIHW
+    convs along C (sparse_masklib.py:169-183)."""
     if pattern not in _PATTERNS:
         raise ValueError(f"unknown sparsity pattern {pattern!r}")
+    calc = _PATTERNS[pattern]
     shape = w.shape
-    w2d = w.reshape(-1, shape[-1])
-    return _PATTERNS[pattern](w2d).reshape(shape)
+    if w.ndim <= 3:
+        w2d = w.reshape(-1, shape[-1])
+        return calc(w2d).reshape(shape)
+    if w.ndim == 4:  # HWIO conv kernel: prune along I (axis 2)
+        h, kw, i, o = shape
+        w2d = w.transpose(0, 1, 3, 2).reshape(h * kw * o, i)
+        mask = calc(w2d)
+        return mask.reshape(h, kw, o, i).transpose(0, 1, 3, 2)
+    raise ValueError(f"cannot sparsify a {w.ndim}-d tensor")
 
 
 def _default_eligible(path: tuple, leaf: Any) -> bool:
     """The reference prunes Linear/Conv weights with both dims ≥ some
     minimum and divisible by 4 (asp.py ``eligible``); here: ≥2-D leaves
     whose last dim divides by 4 and whose name isn't bias/norm-like."""
-    if getattr(leaf, "ndim", 0) < 2 or leaf.shape[-1] % 4:
+    if getattr(leaf, "ndim", 0) < 2:
+        return False
+    # the pruned axis must divide by 4: last dim for 1-3d, the
+    # input-channel axis (HWIO axis 2) for 4d conv kernels — keep this
+    # in lock-step with create_mask's nd routing
+    pruned_dim = leaf.shape[2] if leaf.ndim == 4 else leaf.shape[-1]
+    if leaf.ndim > 4 or pruned_dim % 4:
         return False
     name = str(path[-1]).lower() if path else ""
     return not any(t in name for t in ("bias", "scale", "norm", "embed"))
@@ -68,6 +246,11 @@ class ASP:
         masks = asp.compute_sparse_masks(params)
         params = asp.apply_masks(params, masks)   # prune_trained_model
         step = asp.wrap_optimizer_step(opt.step, masks)  # re-mask updates
+
+    The reference's ``allow_recompute_mask`` (keep the pruned values so
+    dense weights can be restored, asp.py:66-68,117-121) maps to
+    ``extract_pruned`` / ``restore_dense``: because params are immutable
+    here, the pruned residue is just another pytree.
     """
 
     def __init__(
@@ -95,6 +278,19 @@ class ASP:
             lambda p, m: jnp.where(m, p, jnp.zeros_like(p)), params, masks
         )
 
+    def extract_pruned(self, params: Any, masks: Any) -> Any:
+        """The values a mask removes (reference: allow_recompute_mask's
+        ``__..._mma_pruned_p`` buffers, asp.py:117-121)."""
+        return jax.tree.map(
+            lambda p, m: jnp.where(m, jnp.zeros_like(p), p), params, masks
+        )
+
+    def restore_dense(self, params: Any, masks: Any, pruned: Any) -> Any:
+        """Undo ``apply_masks`` given the extracted residue."""
+        return jax.tree.map(
+            lambda p, m, r: jnp.where(m, p, r), params, masks, pruned
+        )
+
     def wrap_optimizer_step(self, step_fn: Callable, masks: Any) -> Callable:
         """The functional analog of ``init_optimizer_for_pruning``'s step
         patch (reference: asp.py:127-153): run the wrapped step, then
@@ -113,3 +309,18 @@ class ASP:
         zeros = sum(int(jnp.size(m)) - int(jnp.sum(m)) for m in leaves)
         total = sum(int(jnp.size(m)) for m in leaves)
         return zeros / max(total, 1)
+
+
+def prune_trained_model(
+    params: Any,
+    step_fn: Callable,
+    mask_calculator: str = "m4n2_1d",
+    eligible: Optional[Callable[[tuple, Any], bool]] = None,
+) -> Tuple[Any, Any, Callable]:
+    """One-call fine-tuning lifecycle (reference: asp.py:212-217
+    ``prune_trained_model = init_model + init_optimizer +
+    compute_sparse_masks``): returns the pruned params, the masks, and a
+    mask-preserving optimizer step for the sparse fine-tune phase."""
+    asp = ASP(mask_calculator, eligible)
+    masks = asp.compute_sparse_masks(params)
+    return asp.apply_masks(params, masks), masks, asp.wrap_optimizer_step(step_fn, masks)

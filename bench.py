@@ -743,10 +743,14 @@ def main():
     platform = None
     backoff = 20
     attempt = 0
-    while budget_left() > MEASURE_RESERVE:
+    # the reserve can never eat the whole budget: at least one probe
+    # attempt always runs (a small-budget env var combo must not turn
+    # the gate into a silent CPU bench)
+    reserve = min(MEASURE_RESERVE, max(0, TOTAL_BUDGET - PROBE_TIMEOUT - 60))
+    while attempt == 0 or budget_left() > reserve:
         ok, probe, err = _run_child(
             ["--child", "probe"],
-            min(PROBE_TIMEOUT, max(30, budget_left() - MEASURE_RESERVE)),
+            min(PROBE_TIMEOUT, max(30, budget_left() - reserve)),
         )
         if ok:
             platform = probe["platform"]
@@ -756,7 +760,7 @@ def main():
         errors.append(f"probe[{attempt}]: {tail}")
         log(f"probe attempt {attempt} failed: {err[-300:]}")
         attempt += 1
-        sleep_for = min(backoff, max(0, budget_left() - MEASURE_RESERVE))
+        sleep_for = min(backoff, max(0, budget_left() - reserve))
         if sleep_for <= 0:
             break
         log(f"probe backoff: sleeping {sleep_for:.0f}s "
@@ -766,7 +770,7 @@ def main():
     if platform is None:
         errors.append(
             f"probe gave up after {attempt} attempts / "
-            f"{time.perf_counter() - t_start:.0f}s (reserve {MEASURE_RESERVE}s)")
+            f"{time.perf_counter() - t_start:.0f}s (reserve {reserve}s)")
 
     result = None
     on_tpu = False

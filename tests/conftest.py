@@ -24,3 +24,16 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-apply the ``l0`` mark to everything not marked ``slow`` so
+    ``pytest -m l0`` is the fast tier and ``pytest`` (no -m) the full
+    suite — the reference's L0/L1 test tiering
+    (/root/reference/tests/L0/run_test.py:1-29)."""
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.l0)

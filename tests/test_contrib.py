@@ -224,6 +224,92 @@ class TestASP:
         w = np.asarray(new_params["dense"]["weight"])
         assert (w == 0).sum() == 64
 
+    @staticmethod
+    def _brute_best_2d(block, m=4, n=2):
+        """Exhaustive numpy search over all doubly-n:m 4x4 masks."""
+        import itertools
+
+        rows = [p for p in set(itertools.permutations([1] * n + [0] * (m - n)))]
+        best, best_score = None, -1.0
+        for combo in itertools.product(rows, repeat=m):
+            cand = np.array(combo)
+            if (cand.sum(0) > n).any():
+                continue
+            score = (np.abs(block) * cand).sum()
+            if score > best_score:
+                best, best_score = cand, score
+        return best, best_score
+
+    def test_2d_best_structure_and_optimality(self):
+        from apex_tpu.contrib.sparsity import mn_2d_best
+
+        w = jax.random.normal(jax.random.PRNGKey(3), (8, 8))
+        mask = np.asarray(mn_2d_best(w))
+        # doubly 2:4 — every 4-row and 4-col group of each block has 2 kept
+        blocks = mask.reshape(2, 4, 2, 4).transpose(0, 2, 1, 3)
+        assert (blocks.sum(-1) == 2).all()  # rows
+        assert (blocks.sum(-2) == 2).all()  # cols
+        # magnitude-optimal vs independent brute force, block by block
+        wb = np.asarray(w).reshape(2, 4, 2, 4).transpose(0, 2, 1, 3)
+        for i in range(2):
+            for j in range(2):
+                _, brute = self._brute_best_2d(wb[i, j])
+                got = (np.abs(wb[i, j]) * blocks[i, j]).sum()
+                assert got >= brute - 1e-5
+
+    def test_2d_greedy_structure(self):
+        from apex_tpu.contrib.sparsity import mn_2d_greedy
+
+        w = jax.random.normal(jax.random.PRNGKey(4), (12, 8))
+        mask = np.asarray(mn_2d_greedy(w))
+        blocks = mask.reshape(3, 4, 2, 4).transpose(0, 2, 1, 3)
+        assert (blocks.sum(-1) == 2).all() and (blocks.sum(-2) == 2).all()
+        # greedy keeps the single largest |w| of every block (it is
+        # visited first and nothing blocks it)
+        wb = np.abs(np.asarray(w)).reshape(3, 4, 2, 4).transpose(0, 2, 1, 3)
+        flat_idx = wb.reshape(6, 16).argmax(-1)
+        kept = blocks.reshape(6, 16)
+        assert all(kept[b, flat_idx[b]] for b in range(6))
+        # non-divisible trailing rows stay dense
+        w_odd = jax.random.normal(jax.random.PRNGKey(5), (6, 8))
+        m_odd = np.asarray(mn_2d_greedy(w_odd))
+        assert m_odd[4:].all()
+
+    def test_mn_generalized(self):
+        from apex_tpu.contrib.sparsity import mn_1d_best
+
+        w = jax.random.normal(jax.random.PRNGKey(6), (4, 16))
+        mask = np.asarray(mn_1d_best(w, m=8, n=4))
+        assert (mask.reshape(4, 2, 8).sum(-1) == 4).all()
+
+    def test_conv_hwio_mask(self):
+        # 4d kernels prune along the input-channel axis (HWIO axis 2)
+        w = jax.random.normal(jax.random.PRNGKey(7), (3, 3, 8, 16))
+        mask = np.asarray(create_mask(w))
+        assert mask.shape == w.shape
+        assert (mask.sum(2) == 4).all()  # 2 of every 4 along I = 8 → 4 kept
+
+    def test_prune_trained_model_lifecycle(self):
+        from apex_tpu.contrib.sparsity import ASP, prune_trained_model
+        from apex_tpu.optimizers import FusedAdam
+
+        params = {"w": jax.random.normal(jax.random.PRNGKey(8), (8, 16)),
+                  "b": jnp.ones((16,))}
+        opt = FusedAdam(lr=0.1)
+        pruned, masks, step = prune_trained_model(params, opt.step)
+        assert (np.asarray(pruned["w"]) == 0).sum() == 64
+        state = opt.init(pruned)
+        grads = jax.tree.map(jnp.ones_like, pruned)
+        new_params, _ = step(state, grads, pruned)
+        assert (np.asarray(new_params["w"]) == 0).sum() == 64
+
+        # dense restore round-trip (allow_recompute_mask analog)
+        asp = ASP()
+        residue = asp.extract_pruned(params, masks)
+        restored = asp.restore_dense(pruned, masks, residue)
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.asarray(params["w"]))
+
 
 def _brute_force_rnnt(logp, target, t_len, u_len, blank):
     """O(T·U) reference DP in numpy."""
