@@ -507,3 +507,124 @@ class BertModel:
                 / jnp.maximum(jax.lax.psum(rows, DATA_PARALLEL_AXIS), 1.0)
             )
         return loss
+
+    def pipeline_grads(
+        self,
+        params: Dict[str, Any],
+        tokens: jnp.ndarray,
+        lm_labels: jnp.ndarray,
+        loss_mask: jnp.ndarray,
+        num_microbatches: int,
+        attention_mask: Optional[jnp.ndarray] = None,
+        binary_labels: Optional[jnp.ndarray] = None,
+        tokentype_ids: Optional[jnp.ndarray] = None,
+    ) -> tuple:
+        """Masked-LM (+ binary) fwd+bwd through the production 1F1B
+        schedule dispatched by ``get_forward_backward_func`` — returns
+        ``(loss, grads)`` with O(pp) activation memory.
+
+        The 1F1B contract needs a *scalar* per-microbatch loss, but the
+        masked mean's denominator spans all microbatches and dp shards.
+        Both denominators are functions of the data only, so they are
+        psum'd *before* the schedule and folded into each microbatch's
+        scalar: ``loss_m = M*(num_m/D + sop_m/R)`` makes
+        ``mean_m loss_m`` exactly the global objective of
+        :meth:`pipeline_loss`, with exact gradients.
+
+        Grad semantics: the returned grads are already psum'd over dp
+        (the objective's denominators are global, so the dp reduction is
+        a sum, not a mean) — step a replicated optimizer with them
+        directly; do not reduce over dp again."""
+        from apex_tpu.transformer.parallel_state import (
+            PIPELINE_PARALLEL_AXIS,
+        )
+        from apex_tpu.transformer.pipeline_parallel import (
+            get_forward_backward_func,
+            sync_replicated_grads,
+        )
+
+        c = self.config
+        b, s = tokens.shape
+        if b % num_microbatches:
+            raise ValueError(
+                f"local batch ({b}) must be divisible by "
+                f"num_microbatches ({num_microbatches})"
+            )
+        mb = b // num_microbatches
+
+        def shard(x):
+            return (
+                None if x is None
+                else x.reshape(num_microbatches, mb, *x.shape[1:])
+            )
+
+        mbs = {
+            "tokens": shard(tokens),
+            "lm_labels": shard(lm_labels),
+            "loss_mask": shard(loss_mask),
+        }
+        if attention_mask is not None:
+            mbs["attention_mask"] = shard(attention_mask)
+        if tokentype_ids is not None:
+            mbs["tokentype_ids"] = shard(tokentype_ids)
+        use_binary = c.add_binary_head and binary_labels is not None
+        if use_binary:
+            mbs["binary_labels"] = shard(binary_labels)
+
+        M = jnp.float32(num_microbatches)
+        den_global = jnp.maximum(jax.lax.psum(
+            jnp.sum(loss_mask.astype(jnp.float32)), DATA_PARALLEL_AXIS
+        ), 1.0)
+        rows_global = jnp.maximum(jax.lax.psum(
+            jnp.float32(b), DATA_PARALLEL_AXIS
+        ), 1.0)
+
+        def first_fn(prm, m):
+            state = {"x": self._embed(
+                prm, m["tokens"], m.get("tokentype_ids")
+            )}
+            if "attention_mask" in m:
+                state["kv_seg"] = self._kv_segments(m["attention_mask"])
+            return state
+
+        def stage_fn(prm, state):
+            segs = None
+            if "kv_seg" in state:
+                segs = (jnp.zeros_like(state["kv_seg"]), state["kv_seg"])
+
+            def body(carry, lp):
+                return self._layer(lp, carry, segs), None
+
+            out, _ = jax.lax.scan(body, state["x"], prm["layers"])
+            return {**state, "x": out}
+
+        def last_fn(prm, state, m):
+            x = self._final_ln(prm, state["x"])
+            per_token = self._per_token_ce(prm, x, m["lm_labels"])
+            mask = m["loss_mask"].astype(jnp.float32)
+            loss_m = jnp.sum(per_token * mask) / den_global
+            if use_binary:
+                logp = jax.nn.log_softmax(
+                    self.binary_logits(prm, x), axis=-1
+                )
+                sop = -jnp.sum(jnp.take_along_axis(
+                    logp, m["binary_labels"][:, None], 1
+                )[:, 0])
+                loss_m = loss_m + sop / rows_global
+            return M * loss_m
+
+        fwd_bwd = get_forward_backward_func(
+            pipeline_model_parallel_size=jax.lax.axis_size(
+                PIPELINE_PARALLEL_AXIS
+            ),
+        )
+        losses, grads = fwd_bwd(first_fn, stage_fn, last_fn, params, mbs)
+        grads = sync_replicated_grads(grads, self.pipeline_param_specs())
+        # each shard's mean(losses) — and each shard's grads — is its
+        # local contribution to the already-globally-normalized
+        # objective; psum over dp completes both
+        loss = jax.lax.psum(jnp.mean(losses), DATA_PARALLEL_AXIS)
+        grads = jax.tree.map(
+            lambda g: jax.lax.psum(g, DATA_PARALLEL_AXIS), grads
+        )
+        return loss, grads

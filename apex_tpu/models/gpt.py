@@ -348,6 +348,21 @@ class GPTModel:
             y = jnp.where(keep, y / (1.0 - c.hidden_dropout), 0.0)
         return residual + y.astype(residual.dtype), aux
 
+    def _pos_slice(self, params: Dict[str, Any], s: int) -> jnp.ndarray:
+        """Local slice of the position table: under context parallelism
+        the (b, s) tokens are the cp-rank's sequence chunk, so positions
+        start at ``cp_rank * s``."""
+        if self.config.context_parallel:
+            from apex_tpu.transformer.parallel_state import (
+                CONTEXT_PARALLEL_AXIS,
+            )
+
+            offset = jax.lax.axis_index(CONTEXT_PARALLEL_AXIS) * s
+            return jax.lax.dynamic_slice_in_dim(
+                params["pos_embedding"], offset, s, axis=0
+            )
+        return params["pos_embedding"][:s]
+
     def hidden_states(
         self,
         params: Dict[str, Any],
@@ -360,19 +375,7 @@ class GPTModel:
         c = self.config
         b, s = tokens.shape
         x = self.embedding.apply(params["embedding"], tokens)
-        if c.context_parallel:
-            # tokens are the local shard of the sequence: position ids
-            # start at cp_rank * s_local
-            from apex_tpu.transformer.parallel_state import (
-                CONTEXT_PARALLEL_AXIS,
-            )
-
-            offset = jax.lax.axis_index(CONTEXT_PARALLEL_AXIS) * s
-            pos = jax.lax.dynamic_slice_in_dim(
-                params["pos_embedding"], offset, s, axis=0
-            )
-        else:
-            pos = params["pos_embedding"][:s]
+        pos = self._pos_slice(params, s)
         x = x + pos[None, :, :].astype(x.dtype)
         x = x.astype(c.compute_dtype)
 
@@ -458,16 +461,58 @@ class GPTModel:
         return loss
 
     # ------------------------------------------------------ pipeline path
-    def pipeline_param_specs(self) -> Dict[str, Any]:
+    def pipeline_param_specs(
+        self, num_model_chunks: Optional[int] = None
+    ) -> Dict[str, Any]:
         """Param specs with the stacked-layer dim sharded over "pp", so
-        each pipeline stage holds its own num_layers/pp layers."""
+        each pipeline stage holds its own num_layers/pp layers.  With
+        ``num_model_chunks`` (virtual pipeline), specs match
+        :meth:`pipeline_chunk_params`'s (V, pp, per, ...) layer layout,
+        sharded over "pp" on axis 1."""
+        from jax.sharding import PartitionSpec as P
+
         from apex_tpu.transformer.pipeline_parallel import (
             pipeline_stage_specs,
         )
 
         specs = self.param_specs()
-        specs["layers"] = pipeline_stage_specs(specs["layers"])
+        if num_model_chunks is None:
+            specs["layers"] = pipeline_stage_specs(specs["layers"])
+        else:
+            specs["layers"] = jax.tree.map(
+                lambda s: P(None, "pp", *s),
+                specs["layers"],
+                is_leaf=lambda x: isinstance(x, P),
+            )
         return specs
+
+    def pipeline_chunk_params(
+        self, params: Dict[str, Any], num_model_chunks: int
+    ) -> Dict[str, Any]:
+        """Rearrange stacked layer params (L, ...) into the interleaved
+        (V, pp, per, ...) chunk layout: chunk v of rank p is global
+        stage ``v*pp + p`` and holds layers ``(v*pp+p)*per + k`` — a
+        plain reshape, because ``l = v*(pp*per) + p*per + k``
+        (reference: model-chunk construction in
+        fwd_bwd_pipelining_with_interleaving.py:22-70)."""
+        from apex_tpu.transformer import parallel_state
+
+        pp = parallel_state.get_pipeline_model_parallel_world_size()
+        V = num_model_chunks
+        L = self.config.num_layers
+        if L % (V * pp):
+            raise ValueError(
+                f"num_layers ({L}) must divide into num_model_chunks * "
+                f"pp ({V}*{pp}) equal chunks"
+            )
+        per = L // (V * pp)
+        return {
+            **params,
+            "layers": jax.tree.map(
+                lambda x: x.reshape(V, pp, per, *x.shape[1:]),
+                params["layers"],
+            ),
+        }
 
     def pipeline_loss(
         self,
@@ -499,7 +544,7 @@ class GPTModel:
 
         def first_fn(m):
             x = self.embedding.apply(params["embedding"], m["tokens"])
-            x = x + params["pos_embedding"][:s][None, :, :].astype(x.dtype)
+            x = x + self._pos_slice(params, s)[None, :, :].astype(x.dtype)
             return x.astype(c.compute_dtype)
 
         def stage_fn(x):
@@ -534,8 +579,14 @@ class GPTModel:
         tokens: jnp.ndarray,
         targets: jnp.ndarray,
         num_microbatches: int,
+        num_model_chunks: Optional[int] = None,
     ) -> tuple:
-        """Fwd+bwd through the true 1F1B schedule: returns
+        """Fwd+bwd through the production pipeline schedule dispatched
+        by ``get_forward_backward_func`` (reference:
+        schedules/__init__.py:1-39): 1F1B, or interleaved 1F1B when
+        ``num_model_chunks`` is given (params then placed by
+        ``pipeline_param_specs(num_model_chunks)`` in the
+        :meth:`pipeline_chunk_params` layout).  Returns
         ``(mean loss, grads)`` directly — in-flight activation memory is
         bounded by the pipeline depth, not ``num_microbatches``
         (PIPELINE_MEMORY.json: flat temp memory from 2 to 32
@@ -545,8 +596,11 @@ class GPTModel:
         shared-param sync AND the dp pmean applied — step the optimizer
         with them directly (do not psum over dp again)."""
         from apex_tpu.transformer.pipeline_parallel import (
-            pipeline_1f1b,
+            get_forward_backward_func,
             sync_replicated_grads,
+        )
+        from apex_tpu.transformer.parallel_state import (
+            PIPELINE_PARALLEL_AXIS,
         )
 
         c = self.config
@@ -564,15 +618,24 @@ class GPTModel:
 
         def first_fn(prm, m):
             x = self.embedding.apply(prm["embedding"], m["tokens"])
-            x = x + prm["pos_embedding"][:s][None, :, :].astype(x.dtype)
+            x = x + self._pos_slice(prm, s)[None, :, :].astype(x.dtype)
             return x.astype(c.compute_dtype)
 
-        def stage_fn(prm, x):
-            def body(h, lp):
-                out, _aux = self._layer(lp, h, None)
-                return out, None
+        def layer_body(h, lp):
+            out, _aux = self._layer(lp, h, None)
+            return out, None
 
-            out, _ = jax.lax.scan(body, x, prm["layers"])
+        def stage_fn(prm, x):
+            out, _ = jax.lax.scan(layer_body, x, prm["layers"])
+            return out
+
+        def chunk_fn(prm, x, v):
+            # local chunk v: (V, 1, per, ...) sliced at [v, 0]
+            chunk = jax.tree.map(
+                lambda l: jax.lax.dynamic_index_in_dim(l, v, 0, False)[0],
+                prm["layers"],
+            )
+            out, _ = jax.lax.scan(layer_body, x, chunk)
             return out
 
         def last_fn(prm, x, m):
@@ -586,12 +649,58 @@ class GPTModel:
             per_token = self._per_token_ce(prm, x, m["targets"])
             return jnp.mean(per_token)
 
-        losses, grads = pipeline_1f1b(
-            first_fn, stage_fn, last_fn, params, mbs
+        fwd_bwd = get_forward_backward_func(
+            virtual_pipeline_model_parallel_size=num_model_chunks,
+            pipeline_model_parallel_size=jax.lax.axis_size(
+                PIPELINE_PARALLEL_AXIS
+            ),
         )
-        grads = sync_replicated_grads(grads, self.pipeline_param_specs())
+        losses, grads = fwd_bwd(
+            first_fn,
+            stage_fn if num_model_chunks is None else chunk_fn,
+            last_fn,
+            params,
+            mbs,
+        )
+        specs = self.pipeline_param_specs(num_model_chunks)
+        grads = sync_replicated_grads(grads, specs)
         loss = jax.lax.pmean(jnp.mean(losses), DATA_PARALLEL_AXIS)
-        grads = jax.tree.map(
-            lambda g: jax.lax.pmean(g, DATA_PARALLEL_AXIS), grads
-        )
+
+        def spec_axes(s):
+            out = set()
+            for part in s:
+                if part is None:
+                    continue
+                out |= set(part) if isinstance(part, tuple) else {part}
+            return out
+
+        def data_reduce(s, g, axis):
+            # the schedule's grads are this data shard's contribution to
+            # ITS local mean loss; the global objective is the
+            # data-axis mean.  Replicated leaves: average the shard
+            # contributions (pmean).  Leaves SHARDED over the data axis
+            # (MoE experts ride "dp" as the ep axis): the all_to_all
+            # transpose already accumulated every shard's contribution
+            # into the owner, so the mean is just the 1/n scale.
+            n = jax.lax.axis_size(axis)
+            if axis in spec_axes(s):
+                return g / n
+            return jax.lax.pmean(g, axis)
+
+        def reduce_tree(grads, axis):
+            return jax.tree.map(
+                lambda s, g: data_reduce(s, g, axis), specs, grads,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+
+        grads = reduce_tree(grads, DATA_PARALLEL_AXIS)
+        if self.config.context_parallel:
+            # sequence shards each saw only their chunk of every
+            # microbatch: average over cp exactly like :meth:`loss`
+            from apex_tpu.transformer.parallel_state import (
+                CONTEXT_PARALLEL_AXIS,
+            )
+
+            loss = jax.lax.pmean(loss, CONTEXT_PARALLEL_AXIS)
+            grads = reduce_tree(grads, CONTEXT_PARALLEL_AXIS)
         return loss, grads

@@ -504,3 +504,108 @@ class T5Model:
             split, remat=c.remat,
         )
         return jax.lax.pmean(jnp.mean(per_micro), DATA_PARALLEL_AXIS)
+
+    def pipeline_grads(
+        self,
+        params: Dict[str, Any],
+        enc_tokens: jnp.ndarray,
+        dec_tokens: jnp.ndarray,
+        targets: jnp.ndarray,
+        num_microbatches: int,
+    ) -> tuple:
+        """Fwd+bwd through the enc-dec schedule dispatched by
+        ``get_forward_backward_func(model_type=encoder_and_decoder)``
+        (reference: schedules/__init__.py:1-39 + common.py ModelType
+        routing) — returns ``(mean loss, grads)``; grads already carry
+        the shared-param sync and the dp pmean, so step the optimizer
+        with them directly.  Falls back to the model's proportional
+        split when no ``pipeline_model_parallel_split_rank_`` was
+        installed at ``initialize_model_parallel`` time."""
+        import functools
+
+        from apex_tpu.transformer import parallel_state
+        from apex_tpu.transformer.enums import ModelType
+        from apex_tpu.transformer.pipeline_parallel import (
+            get_forward_backward_func,
+            sync_replicated_grads,
+        )
+        from apex_tpu.transformer.pipeline_parallel.schedules import (
+            _fwd_bwd_encdec,
+        )
+
+        c = self.config
+        split = self.pipeline_split_stage()
+        b = enc_tokens.shape[0]
+        if b % num_microbatches:
+            raise ValueError(
+                f"local batch ({b}) must be divisible by "
+                f"num_microbatches ({num_microbatches})"
+            )
+        mb = b // num_microbatches
+        mbs = {
+            "enc_tokens": enc_tokens.reshape(num_microbatches, mb, -1),
+            "dec_tokens": dec_tokens.reshape(num_microbatches, mb, -1),
+            "targets": targets.reshape(num_microbatches, mb, -1),
+        }
+
+        def enc_entry(prm, m):
+            return self._embed(prm, m["enc_tokens"], "enc_pos_embedding")
+
+        def dec_entry(prm, m):
+            return self._embed(prm, m["dec_tokens"], "dec_pos_embedding")
+
+        def enc_stage(prm, x):
+            def body(h, lp):
+                return self._enc_layer(lp, h), None
+
+            out, _ = jax.lax.scan(body, x, prm["layers"])
+            normed = fused_layer_norm_affine(
+                out.astype(jnp.float32),
+                prm["enc_final_ln"]["scale"],
+                prm["enc_final_ln"]["bias"],
+                (c.hidden_size,), eps=c.layernorm_epsilon,
+            ).astype(out.dtype)
+            is_last_enc = (
+                jax.lax.axis_index(PIPELINE_PARALLEL_AXIS) == split - 1
+            )
+            return jnp.where(is_last_enc, normed, out)
+
+        def dec_stage(prm, x, memory):
+            def body(h, lp):
+                return self._dec_layer(lp, h, memory), None
+
+            out, _ = jax.lax.scan(body, x, prm["layers"])
+            return out
+
+        def last_fn(prm, x, m):
+            x = fused_layer_norm_affine(
+                x.astype(jnp.float32),
+                prm["dec_final_ln"]["scale"],
+                prm["dec_final_ln"]["bias"],
+                (c.hidden_size,), eps=c.layernorm_epsilon,
+            ).astype(c.compute_dtype)
+            per_token = self._per_token_ce(prm, x, m["targets"])
+            return jnp.mean(per_token)
+
+        pp = jax.lax.axis_size(PIPELINE_PARALLEL_AXIS)
+        if parallel_state.get_pipeline_model_parallel_split_rank() is not None:
+            fwd_bwd = get_forward_backward_func(
+                pipeline_model_parallel_size=pp,
+                model_type=ModelType.encoder_and_decoder,
+            )
+        else:
+            fwd_bwd = functools.partial(_fwd_bwd_encdec, split_stage=split)
+        losses, grads = fwd_bwd(
+            enc_entry, enc_stage, dec_entry, dec_stage, last_fn,
+            params, mbs, remat=c.remat,
+        )
+        grads = sync_replicated_grads(grads, self.pipeline_param_specs())
+        loss = jax.lax.pmean(jnp.mean(losses), DATA_PARALLEL_AXIS)
+        # the schedule's grads are shard-local contributions (the 1F1B
+        # family's shared dp convention); pmean makes them the gradient
+        # of the dp-mean loss — the same optimizer-ready convention as
+        # GPTModel.pipeline_1f1b_grads
+        grads = jax.tree.map(
+            lambda g: jax.lax.pmean(g, DATA_PARALLEL_AXIS), grads
+        )
+        return loss, grads

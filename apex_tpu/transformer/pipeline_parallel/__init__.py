@@ -34,12 +34,14 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     get_forward_backward_func,
     pipeline,
     pipeline_1f1b,
+    pipeline_1f1b_interleaved,
     pipeline_encdec,
 )
 
 __all__ = [
     "pipeline",
     "pipeline_1f1b",
+    "pipeline_1f1b_interleaved",
     "pipeline_encdec",
     "pipeline_stage_specs",
     "sync_replicated_grads",

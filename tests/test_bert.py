@@ -211,3 +211,76 @@ def test_bert_pipeline_matches_sequential():
             )
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def test_bert_pipeline_grads_matches_sequential():
+    """BERT fwd+bwd through the dispatched 1F1B schedule == sequential
+    loss+grads (same comparison as the GPipe pipeline test — the
+    per-microbatch scalars fold in the precomputed global mask
+    denominator, so gradients are exact)."""
+    from apex_tpu.transformer.pipeline_parallel import sync_replicated_grads
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2, pipeline_model_parallel_size_=2
+    )
+    try:
+        cfg = small_config()
+        model = BertModel(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        ks = jax.random.split(jax.random.PRNGKey(7), 5)
+        tokens = jax.random.randint(ks[0], (8, 12), 0, cfg.vocab_size)
+        labels = jax.random.randint(ks[1], (8, 12), 0, cfg.vocab_size)
+        loss_mask = (jax.random.uniform(ks[2], (8, 12)) < 0.4).astype(
+            jnp.float32)
+        attn_mask = jax.random.uniform(ks[3], (8, 12)) < 0.9
+        bin_labels = jax.random.randint(ks[4], (8,), 0, 2)
+
+        seq_specs = model.param_specs()
+
+        def place(tree, sp):
+            return jax.device_put(tree, jax.tree.map(
+                lambda s: NamedSharding(mesh, s), sp,
+                is_leaf=lambda x: isinstance(x, P)))
+
+        # NOTE: model.loss psums over dp inside, and the params enter
+        # dp-invariant, so autodiff already inserts the dp psum — these
+        # grads are the full global gradient, directly comparable to
+        # pipeline_grads' explicitly-psum'd ones.
+        seq_grad = jax.jit(jax.shard_map(
+            jax.value_and_grad(
+                lambda p, t, l, m, a, b: model.loss(
+                    p, t, l, m, attention_mask=a, binary_labels=b)
+            ),
+            mesh=mesh,
+            in_specs=(seq_specs,) + (P("dp"),) * 5,
+            out_specs=(P(), seq_specs),
+        ))
+        ref_loss, ref_grads = seq_grad(
+            place(params, seq_specs), tokens, labels, loss_mask,
+            attn_mask, bin_labels,
+        )
+        ref_grads = jax.device_get(ref_grads)
+
+        pp_specs = model.pipeline_param_specs()
+        fb = jax.jit(jax.shard_map(
+            lambda p, t, l, m, a, b: model.pipeline_grads(
+                p, t, l, m, 2, attention_mask=a, binary_labels=b),
+            mesh=mesh,
+            in_specs=(pp_specs,) + (P("dp"),) * 5,
+            out_specs=(P(), pp_specs),
+        ))
+        loss, grads = fb(
+            place(params, pp_specs), tokens, labels, loss_mask,
+            attn_mask, bin_labels,
+        )
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+        for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(jax.device_get(grads)),
+            jax.tree_util.tree_leaves_with_path(ref_grads),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-6,
+                err_msg=str(path),
+            )
+    finally:
+        parallel_state.destroy_model_parallel()

@@ -17,6 +17,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
+
+pytestmark = pytest.mark.slow  # L1-style cross-product tier (reference: tests/L1)
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu import amp
