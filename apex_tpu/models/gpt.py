@@ -101,8 +101,10 @@ class GPTConfig:
     # Experts shard over "dp"; the Switch aux loss is added to the LM
     # loss with moe_aux_weight.
     num_experts: Optional[int] = None
+    moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    moe_router_z_loss_weight: float = 0.0
 
     def __post_init__(self):
         if self.policy is not None:
@@ -202,7 +204,9 @@ class GPTModel:
                 c.hidden_size,
                 c.ffn_hidden_size,
                 c.num_experts,
+                top_k=c.moe_top_k,
                 capacity_factor=c.moe_capacity_factor,
+                router_z_loss_weight=c.moe_router_z_loss_weight,
                 tp_axis=axis_name,
                 params_dtype=c.params_dtype,
                 init_std=c.init_method_std,

@@ -49,10 +49,12 @@ OP_CLASSES = (
      "compute"),
     ("sort", r"sort|top-k|topk", "compute"),
     ("rng", r"\brng\b|threefry|random|philox", "compute"),
-    ("scatter_gather", r"scatter|gather", "memory"),
     ("slice_update", r"dynamic-slice|dynamic_slice|dynamic-update|"
      r"dynamic_update|slice|pad", "memory"),
     ("reduction", r"reduce|cumsum|cumulative", "compute"),
+    # word-bound and AFTER the specific classes: select-and-scatter and
+    # gather-bearing fusion names must not misfile here (ADVICE r3)
+    ("scatter_gather", r"(?<!and-)\bscatter\b|\bgather\b", "memory"),
     ("normalization", r"norm|batch-norm|batch_norm", "compute"),
     ("copy_layout", r"copy|transpose|reshape|bitcast|broadcast|concat|"
      r"reverse|tuple|convert", "memory"),

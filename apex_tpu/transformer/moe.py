@@ -3,8 +3,10 @@
 Beyond-reference capability: SURVEY.md §2.3 records expert parallelism
 as **absent** from the reference snapshot.  TPU-native design:
 
-- Switch-style top-1 routing with a fixed per-(expert, source-rank)
-  capacity — static shapes, so the whole layer jits;
+- Switch-style top-1 routing, or GShard/Mixtral-style top-k (renormalized
+  gates, choice-major capacity priority, optional ST-MoE router z-loss),
+  with a fixed per-(expert, source-rank) capacity — static shapes, so
+  the whole layer jits;
 - experts sharded over an **expert-parallel mesh axis** (default "dp",
   the usual Megatron choice: expert weights ride the data-parallel
   ranks); tokens travel to their expert's rank and back with two
@@ -52,16 +54,24 @@ class MoEMLP:
         ffn_hidden_size: int,
         num_experts: int,
         *,
+        top_k: int = 1,
         capacity_factor: float = 1.25,
+        router_z_loss_weight: float = 0.0,
         ep_axis: str = DATA_PARALLEL_AXIS,
         tp_axis: str = TENSOR_PARALLEL_AXIS,
         params_dtype: Any = jnp.float32,
         init_std: float = 0.02,
     ):
+        if not 1 <= top_k <= num_experts:
+            raise ValueError(
+                f"top_k ({top_k}) must be in [1, num_experts={num_experts}]"
+            )
         self.hidden_size = hidden_size
         self.ffn_hidden_size = ffn_hidden_size
         self.num_experts = num_experts
+        self.top_k = top_k
         self.capacity_factor = capacity_factor
+        self.router_z_loss_weight = router_z_loss_weight
         self.ep_axis = ep_axis
         self.tp_axis = tp_axis
         self.params_dtype = params_dtype
@@ -106,15 +116,21 @@ class MoEMLP:
         one-hot-einsum send/return contractions — the standard
         static-shape TPU MoE pattern (Mesh-TensorFlow/Switch): no
         scatters or gathers, everything rides the MXU.  The dispatch
-        mask is (n, E, cap) ≈ 1.25·n² entries (cap ≈ 1.25·n/E), e.g.
-        ~40 MB bf16 at n=4096 per-rank tokens; n here is the *per-rank*
-        token count under dp/ep sharding, not the global batch."""
+        mask is (n, E, cap) ≈ cf·k·n² entries (cap ≈ cf·k·n/E), e.g.
+        ~50 MB bf16 at n=4096 per-rank tokens for top-1 at cf=1.25, and
+        k× that for top-k (plus the transient (k, n, E, cap) ``mask_k``
+        buffer, another k× before it collapses); n here is the
+        *per-rank* token count under dp/ep sharding, not the global
+        batch."""
         b, s, h = x.shape
         n = b * s
         E = self.num_experts
+        k = self.top_k
         ep = lax.axis_size(self.ep_axis)
         e_local = E // ep
-        cap = max(1, int(self.capacity_factor * n / E))
+        # expected assignments per expert: k*n/E (each token makes k
+        # choices — GShard/ST-MoE convention)
+        cap = max(1, int(self.capacity_factor * k * n / E))
 
         flat = x.reshape(n, h)
         logits = jnp.matmul(
@@ -122,31 +138,54 @@ class MoEMLP:
             params["router"]["weight"].astype(jnp.float32),
         )
         probs = jax.nn.softmax(logits, axis=-1)          # (n, E)
-        gate = jnp.max(probs, axis=-1)                   # (n,)
-        expert_idx = jnp.argmax(probs, axis=-1)          # (n,)
+        topk_probs, topk_idx = lax.top_k(probs, k)       # (n, k)
+        if k == 1:
+            # Switch convention: the gate IS the chosen prob (pushes the
+            # router toward confident assignments)
+            gates = topk_probs
+        else:
+            # GShard/Mixtral convention: renormalize over the k chosen
+            gates = topk_probs / jnp.sum(topk_probs, -1, keepdims=True)
 
-        # Switch aux loss: E * Σ_e (fraction routed to e)·(mean prob of e)
-        one_hot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
-        frac = jnp.mean(one_hot, axis=0)
+        one_hot_k = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)
+
+        # load-balance aux (Switch for k=1, its k-choice generalization
+        # otherwise): E * Σ_e (fraction of the n*k assignments to e) ·
+        # (mean router prob of e)
+        frac = jnp.sum(one_hot_k, axis=(0, 1)) / (n * k)
         mean_prob = jnp.mean(probs, axis=0)
         aux = E * jnp.sum(frac * mean_prob)
+        if self.router_z_loss_weight:
+            # ST-MoE router z-loss: keeps router logits small so the
+            # fp32 softmax stays well-conditioned
+            z = jax.scipy.special.logsumexp(logits, axis=-1)
+            aux = aux + self.router_z_loss_weight * jnp.mean(z * z)
 
-        # position of each token within its expert's capacity buffer
-        pos = jnp.cumsum(one_hot, axis=0) * one_hot      # (n, E)
+        # capacity positions with choice-major priority (every token's
+        # 1st choice outranks all 2nd choices — GShard): flatten the
+        # (k, n) assignment grid and cumsum down it
+        oh = jnp.moveaxis(one_hot_k, 1, 0).reshape(k * n, E)
+        pos = jnp.cumsum(oh, axis=0) * oh                # (k*n, E)
         pos = jnp.sum(pos, axis=-1).astype(jnp.int32) - 1
         keep = pos < cap
 
-        # dispatch buffers: (E, cap, h), one slot per routed token.
+        # dispatch buffers: (E, cap, h), one slot per routed assignment.
         # Built with a one-hot einsum, not scatter-add: scatters serialize
         # on TPU while the (n,E,cap)x(n,h) contraction rides the MXU —
         # the Mesh-TensorFlow/Switch dispatch pattern
         safe_pos = jnp.where(keep, pos, 0)
-        # mask built directly in compute dtype: one (n, E, cap) buffer,
-        # no fp32 intermediates
-        dispatch_mask = (
-            one_hot.astype(x.dtype)[:, :, None]
+        # masks built directly in compute dtype: (k, n, E, cap), then the
+        # k choices collapse — a token's k experts are distinct, so the
+        # summed masks never collide in a slot
+        mask_k = (
+            oh.astype(x.dtype)[:, :, None]
             * jax.nn.one_hot(safe_pos, cap, dtype=x.dtype)[:, None, :]
             * keep[:, None, None].astype(x.dtype)
+        ).reshape(k, n, E, cap)
+        dispatch_mask = jnp.sum(mask_k, axis=0)          # (n, E, cap)
+        gates_k = jnp.moveaxis(gates, 1, 0).astype(x.dtype)  # (k, n)
+        combine_mask = jnp.sum(
+            mask_k * gates_k[:, :, None, None], axis=0
         )                                                # (n, E, cap)
         dispatch = jnp.einsum("nec,nh->ech", dispatch_mask, flat)
 
@@ -174,11 +213,10 @@ class MoEMLP:
         )                                                # (E, cap, h)
 
         # gather-back is the transposed one-hot contraction (MXU, no
-        # gather); dispatch_mask already zeroes capacity-dropped tokens,
-        # so gating by `gate` reproduces weight = keep * gate exactly
+        # gather); combine_mask carries each assignment's gate and
+        # already zeroes capacity-dropped ones, so the k expert outputs
+        # mix as Σ_i gate_i · expert_i(x) exactly
         out = jnp.einsum(
-            "nec,ech->nh",
-            dispatch_mask * gate.astype(x.dtype)[:, None, None],
-            combined.astype(x.dtype),
+            "nec,ech->nh", combine_mask, combined.astype(x.dtype)
         )
         return out.reshape(b, s, h), aux

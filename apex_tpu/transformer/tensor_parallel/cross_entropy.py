@@ -27,6 +27,24 @@ __all__ = [
 ]
 
 
+# one measured default for BOTH fused-CE entry points (v5e bench config:
+# −1.6 ms/step at 8192, PROFILE_r03.md exp 5); ADVICE r3: the two
+# signatures previously disagreed (8192 vs 4096)
+FUSED_CE_DEFAULT_CHUNK = 8192
+
+
+def _largest_chunk_divisor(v_local: int, chunk: int) -> int:
+    """Largest divisor of ``v_local`` that is <= ``chunk`` — the fused
+    CE walks equal weight slices, and common vocab shards (32000/tp)
+    rarely divide by a power-of-two chunk; shrinking to the nearest
+    divisor (32000 → 8000) keeps the fused path engaged instead of
+    silently materializing the full logits (ADVICE r3)."""
+    for d in range(min(chunk, v_local), 0, -1):
+        if v_local % d == 0:
+            return d
+    return 1
+
+
 def lm_head_cross_entropy(
     hidden: jnp.ndarray,
     weight: jnp.ndarray,
@@ -34,7 +52,7 @@ def lm_head_cross_entropy(
     *,
     axis_name: str = TENSOR_PARALLEL_AXIS,
     fused: bool = True,
-    chunk: int = 8192,
+    chunk: int = FUSED_CE_DEFAULT_CHUNK,
     bias: "jnp.ndarray | None" = None,
     smoothing: float = 0.0,
 ) -> jnp.ndarray:
@@ -311,7 +329,7 @@ def vocab_parallel_cross_entropy_from_hidden(
     weight: jnp.ndarray,
     target: jnp.ndarray,
     axis_name: str = TENSOR_PARALLEL_AXIS,
-    chunk: int = 4096,
+    chunk: int = FUSED_CE_DEFAULT_CHUNK,
     bias: "jnp.ndarray | None" = None,
     smoothing: float = 0.0,
 ) -> jnp.ndarray:
@@ -332,20 +350,28 @@ def vocab_parallel_cross_entropy_from_hidden(
     global ids; optional ``bias``: (vocab/tp,) per-vocab logit bias (the
     BERT MLM head's); ``smoothing``: uniform label smoothing over the
     global vocab (contrib.xentropy semantics).  Returns (...) fp32
-    losses.  Falls back to the two-step path when vocab/tp is not
-    divisible by ``chunk``.
+    losses.  When vocab/tp does not divide by ``chunk``, the chunk
+    auto-shrinks to the largest divisor so the fused path stays
+    engaged; only a near-prime shard (best divisor < 512) falls back to
+    the two-step logits path.
     """
     lead = hidden.shape[:-1]
     h = hidden.shape[-1]
     if weight.shape[0] % chunk:
-        logits = jnp.einsum(
-            "...h,vh->...v", hidden, weight.astype(hidden.dtype)
-        )
-        if bias is not None:
-            logits = logits + bias.astype(logits.dtype)
-        return vocab_parallel_cross_entropy(
-            logits, target, axis_name, smoothing=smoothing
-        )
+        chunk = _largest_chunk_divisor(weight.shape[0], chunk)
+        if chunk < min(512, weight.shape[0]):
+            # near-prime shard: the only dividing chunks are tiny and
+            # the scan overhead would swamp the fusion win.  An
+            # explicitly-passed small chunk that DIVIDES is honored —
+            # the fallback only fires when the auto-shrink degraded it.
+            logits = jnp.einsum(
+                "...h,vh->...v", hidden, weight.astype(hidden.dtype)
+            )
+            if bias is not None:
+                logits = logits + bias.astype(logits.dtype)
+            return vocab_parallel_cross_entropy(
+                logits, target, axis_name, smoothing=smoothing
+            )
     if bias is None:
         bias = jnp.zeros((weight.shape[0],), jnp.float32)
     x = hidden.reshape(-1, h)

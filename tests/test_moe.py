@@ -121,3 +121,117 @@ def test_moe_trains_and_grads_are_per_expert():
         assert g_w1.shape == (E, H, F)
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def _dense_topk_reference(layer, params, x, k):
+    """Token-by-token numpy mixture: Σ_{i<=k} gate_i * FFN_{e_i}(x)."""
+    b, s, h = x.shape
+    flat = np.asarray(x).reshape(-1, h)
+    w_r = np.asarray(params["router"]["weight"], np.float32)
+    w1 = np.asarray(params["w1"], np.float32)
+    w2 = np.asarray(params["w2"], np.float32)
+    logits = flat.astype(np.float32) @ w_r
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    out = np.zeros_like(flat, dtype=np.float32)
+    for t in range(flat.shape[0]):
+        idx = np.argsort(-probs[t])[:k]
+        g = probs[t, idx]
+        if k > 1:
+            g = g / g.sum()
+        for e, gi in zip(idx, g):
+            h1 = flat[t] @ w1[e]
+            h1 = 0.5 * h1 * (1 + np.tanh(
+                np.sqrt(2 / np.pi) * (h1 + 0.044715 * h1 ** 3)))
+            out[t] += gi * (h1 @ w2[e])
+    return out.reshape(b, s, h)
+
+
+def test_top2_matches_dense_mixture():
+    """top_k=2 ep-sharded routing == the dense 2-expert mixture
+    (GShard/Mixtral convention: renormalized top-2 gates), capacity
+    large enough that nothing drops, on the 8-device mesh."""
+    layer = MoEMLP(H, F, E, top_k=2, capacity_factor=float(E))
+    params = layer.init(jax.random.PRNGKey(2))
+    x = 0.5 * jax.random.normal(jax.random.PRNGKey(3), (8, 4, H))
+    ref = _dense_topk_reference(layer, params, x, k=2)
+
+    mesh = parallel_state.initialize_model_parallel()  # dp=8 = ep
+    try:
+        fn, specs = build(mesh, layer)
+        placed = place(mesh, params, specs)
+        out, aux = fn(placed, x)
+        np.testing.assert_allclose(
+            np.asarray(out), ref, rtol=2e-4, atol=2e-5
+        )
+        assert np.isfinite(float(aux))
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_top2_capacity_priority():
+    """Choice-major priority: every 1st choice outranks every 2nd choice
+    for capacity (GShard ordering).  Alternating-preference setup with
+    cap=2 per expert: choice-major keeps tokens {0,2} on expert 0 and
+    {1,3} on expert 1 (all 1st choices); token-major order would keep
+    {0,1} on both instead — so tokens 2 and 3 surviving, and 4-7
+    dropping, pins the ordering."""
+    E2, k, n = 2, 2, 8
+    # cap = int(cf * k * n / E) = 2
+    layer = MoEMLP(H, F, E2, top_k=2, capacity_factor=0.25)
+    params = layer.init(jax.random.PRNGKey(4))
+    # router reads feature 0: even tokens prefer e0, odd prefer e1
+    params["router"]["weight"] = (
+        jnp.zeros((H, 2)).at[0, 0].set(1.0).at[0, 1].set(-1.0)
+    )
+    # distinguishable experts: e0 ≈ +gelu(x), e1 ≈ -gelu(x)
+    eye = jnp.eye(H)
+    w1 = jnp.zeros((E2, H, F)).at[:, :, :H].set(eye[None])
+    w2 = jnp.zeros((E2, F, H))
+    w2 = w2.at[0, :H, :].set(eye).at[1, :H, :].set(-eye)
+    params = {**params, "w1": w1, "w2": w2}
+
+    # token t: feature0 = +1 (even) / -1 (odd), rest 0.3
+    flat = jnp.full((n, H), 0.3)
+    flat = flat.at[:, 0].set(jnp.where(jnp.arange(n) % 2 == 0, 1.0, -1.0))
+    x = flat.reshape(2, 4, H)
+
+    mesh = parallel_state.initialize_model_parallel(context_parallel_size_=8)
+    try:
+        fn, specs = build(mesh, layer)
+        out, aux = fn(params, x)
+        s = np.asarray(out).reshape(n, H).sum(-1)
+        # tokens 0,2 kept on e0 (+), 1,3 on e1 (−); 4-7 fully dropped
+        assert s[0] > 1e-3 and s[2] > 1e-3, s
+        assert s[1] < -1e-3 and s[3] < -1e-3, s
+        np.testing.assert_allclose(s[4:], 0.0, atol=1e-6)
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_router_z_loss():
+    """router_z_loss_weight adds mean(logsumexp²) to the aux scalar."""
+    base = MoEMLP(H, F, E, top_k=2)
+    withz = MoEMLP(H, F, E, top_k=2, router_z_loss_weight=1.0)
+    params = base.init(jax.random.PRNGKey(5))
+    x = 0.5 * jax.random.normal(jax.random.PRNGKey(6), (2, 4, H))
+    mesh = parallel_state.initialize_model_parallel(context_parallel_size_=8)
+    try:
+        _, aux0 = build(mesh, base)[0](params, x)
+        _, aux1 = build(mesh, withz)[0](params, x)
+        flat = np.asarray(x).reshape(-1, H).astype(np.float32)
+        logits = flat @ np.asarray(params["router"]["weight"], np.float32)
+        z = np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                   .sum(-1)) + logits.max(-1)
+        np.testing.assert_allclose(
+            float(aux1) - float(aux0), np.mean(z * z), rtol=1e-5
+        )
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_top_k_validation():
+    with pytest.raises(ValueError, match="top_k"):
+        MoEMLP(H, F, E, top_k=0)
+    with pytest.raises(ValueError, match="top_k"):
+        MoEMLP(H, F, E, top_k=E + 1)
