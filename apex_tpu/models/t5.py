@@ -79,6 +79,13 @@ class T5Config:
     fused_ce: bool = True
     fused_ce_chunk: int = 8192
     attention_impl: Optional[str] = None
+    # route the pipeline path through pipeline_encdec_fused: ONE
+    # homogeneous stage body per tick (gated cross-attention +
+    # data-selected causal bias) instead of running both the encoder and
+    # decoder bodies on every stage and selecting — collapses the
+    # two-stream schedule's 2x per-tick FLOPs to ~1 decoder body.
+    # False keeps the original two-stream pipeline_encdec.
+    fused_pipeline: bool = True
 
     def __post_init__(self):
         if self.policy is not None:
@@ -249,7 +256,8 @@ class T5Model:
         b, h, s, d = x.shape
         return jnp.moveaxis(x, 1, 2).reshape(b, s, h * d)
 
-    def _self_attention(self, lp, x, causal: bool):
+    def _self_attention(self, lp, x, causal: bool, bias=None,
+                        q_seg=None, kv_seg=None):
         c = self.config
         y = fused_layer_norm_affine(
             x, lp["ln1"]["scale"], lp["ln1"]["bias"],
@@ -257,12 +265,16 @@ class T5Model:
         ).astype(c.compute_dtype)
         q, k, v = self._split_heads(self.qkv.apply(lp["qkv"], y), 3)
         attn = flash_attention(
-            q, k, v, causal=causal, implementation=c.attention_impl
+            q, k, v, causal=causal, bias=bias,
+            q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+            bias_requires_grad=False,
+            implementation=c.attention_impl,
         )
         out = self.attn_proj.apply(lp["attn_proj"], self._merge_heads(attn))
         return x + out.astype(x.dtype)
 
-    def _cross_attention(self, lp, x, memory):
+    def _cross_attention(self, lp, x, memory, gate=None,
+                         q_seg=None, kv_seg=None):
         c = self.config
         y = fused_layer_norm_affine(
             x, lp["ln_cross"]["scale"], lp["ln_cross"]["bias"],
@@ -274,9 +286,17 @@ class T5Model:
             2,
         )
         attn = flash_attention(
-            q, k, v, causal=False, implementation=c.attention_impl
+            q, k, v, causal=False,
+            q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+            implementation=c.attention_impl,
         )
         out = self.cross_proj.apply(lp["cross_proj"], self._merge_heads(attn))
+        if gate is not None:
+            # fused-pipeline encoder stages: the whole cross-attention
+            # contribution (and its weight gradients) is scaled to zero
+            # by the stage-varying gate — the FLOPs run (that is the
+            # SPMD deal) but the math and grads match _enc_layer exactly
+            out = out * gate
         return x + out.astype(x.dtype)
 
     def _mlp(self, lp, x):
@@ -430,6 +450,94 @@ class T5Model:
             )
         return split
 
+    def _fused_pipeline_fns(self, split: int, s_enc: int, s_dec: int):
+        """Entry/stage/exit functions for the one-body-per-tick
+        :func:`~apex_tpu.transformer.pipeline_parallel.
+        pipeline_encdec_fused` schedule.
+
+        Both streams are padded to ``S = max(s_enc, s_dec)`` so one
+        activation shape serves encoder and decoder stages; pad lanes
+        are isolated by attention segment ids (valid=1, pad=0 — pad
+        keys never reach valid queries; pad-query rows attend only
+        other pad positions, so they carry garbage that is sliced off
+        before the loss, never mixed in).  Stage behaviour is pure
+        data selection on the device-varying stage index:
+
+        - causality: a ``(S, S)`` additive bias that is the causal mask
+          on decoder stages and exactly zero on encoder stages
+          (``bias_requires_grad=False`` keeps the flash backward free
+          of dbias blocks);
+        - cross-attention: computed on every stage (the single-program
+          SPMD cost) but scaled by ``gate = stage >= split``, so
+          encoder math and gradients match ``_enc_layer`` exactly;
+        - the last encoder stage emits the encoder-final-layernormed
+          memory, as in the two-stream schedule.
+        """
+        c = self.config
+        S = max(s_enc, s_dec)
+        need_segs = (s_enc != S) or (s_dec != S)
+        pos = jnp.arange(S)
+        enc_valid = (pos < s_enc).astype(jnp.int32)
+        dec_valid = (pos < s_dec).astype(jnp.int32)
+        qi = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        causal_neg = jnp.where(ki <= qi, 0.0, -1e30).astype(jnp.float32)
+
+        def pad(x):
+            if x.shape[1] == S:
+                return x
+            return jnp.pad(x, ((0, 0), (0, S - x.shape[1]), (0, 0)))
+
+        def enc_entry(prm, m):
+            return pad(self._embed(prm, m["enc_tokens"], "enc_pos_embedding"))
+
+        def dec_entry(prm, m):
+            return pad(self._embed(prm, m["dec_tokens"], "dec_pos_embedding"))
+
+        def stage_fn(prm, x, mem, stage):
+            is_dec = stage >= split
+            bias = causal_neg * is_dec.astype(jnp.float32)
+            gate = is_dec.astype(c.compute_dtype)
+            if need_segs:
+                b = x.shape[0]
+                self_valid = jnp.where(is_dec, dec_valid, enc_valid)
+                self_seg = jnp.broadcast_to(self_valid[None], (b, S))
+                mem_seg = jnp.broadcast_to(enc_valid[None], (b, S))
+            else:
+                self_seg = mem_seg = None
+
+            def body(h, lp):
+                h = self._self_attention(
+                    lp, h, causal=False, bias=bias,
+                    q_seg=self_seg, kv_seg=self_seg,
+                )
+                h = self._cross_attention(
+                    lp, h, mem, gate=gate,
+                    q_seg=self_seg, kv_seg=mem_seg,
+                )
+                return self._mlp(lp, h), None
+
+            out, _ = jax.lax.scan(body, x, prm["layers"])
+            normed = fused_layer_norm_affine(
+                out.astype(jnp.float32),
+                prm["enc_final_ln"]["scale"],
+                prm["enc_final_ln"]["bias"],
+                (c.hidden_size,), eps=c.layernorm_epsilon,
+            ).astype(out.dtype)
+            return jnp.where(stage == split - 1, normed, out)
+
+        def last_fn(prm, y, m):
+            x = fused_layer_norm_affine(
+                y[:, :s_dec].astype(jnp.float32),
+                prm["dec_final_ln"]["scale"],
+                prm["dec_final_ln"]["bias"],
+                (c.hidden_size,), eps=c.layernorm_epsilon,
+            ).astype(c.compute_dtype)
+            per_token = self._per_token_ce(prm, x, m["targets"])
+            return jnp.mean(per_token)
+
+        return enc_entry, dec_entry, stage_fn, last_fn
+
     def pipeline_loss(
         self,
         params: Dict[str, Any],
@@ -441,8 +549,12 @@ class T5Model:
         """Mean CE through the compiled encoder-decoder pipeline — call
         inside shard_map with params from :meth:`pipeline_params` placed
         by :meth:`pipeline_param_specs` (``params["layers"]`` is then the
-        local stage's layer stack)."""
-        from apex_tpu.transformer.pipeline_parallel import pipeline_encdec
+        local stage's layer stack).  ``config.fused_pipeline`` routes
+        through the one-body-per-tick fused schedule (default)."""
+        from apex_tpu.transformer.pipeline_parallel import (
+            pipeline_encdec,
+            pipeline_encdec_fused,
+        )
 
         c = self.config
         split = self.pipeline_split_stage()
@@ -458,6 +570,19 @@ class T5Model:
             "dec_tokens": dec_tokens.reshape(num_microbatches, mb, -1),
             "targets": targets.reshape(num_microbatches, mb, -1),
         }
+
+        if c.fused_pipeline:
+            f_enc, f_dec, f_stage, f_last = self._fused_pipeline_fns(
+                split, enc_tokens.shape[1], dec_tokens.shape[1]
+            )
+            per_micro = pipeline_encdec_fused(
+                lambda m: f_enc(params, m),
+                lambda m: f_dec(params, m),
+                lambda x, mem, stage: f_stage(params, x, mem, stage),
+                lambda y, m: f_last(params, y, m),
+                mbs, split, remat=c.remat,
+            )
+            return jax.lax.pmean(jnp.mean(per_micro), DATA_PARALLEL_AXIS)
 
         def enc_entry(m):
             return self._embed(params, m["enc_tokens"], "enc_pos_embedding")
@@ -521,18 +646,6 @@ class T5Model:
         with them directly.  Falls back to the model's proportional
         split when no ``pipeline_model_parallel_split_rank_`` was
         installed at ``initialize_model_parallel`` time."""
-        import functools
-
-        from apex_tpu.transformer import parallel_state
-        from apex_tpu.transformer.enums import ModelType
-        from apex_tpu.transformer.pipeline_parallel import (
-            get_forward_backward_func,
-            sync_replicated_grads,
-        )
-        from apex_tpu.transformer.pipeline_parallel.schedules import (
-            _fwd_bwd_encdec,
-        )
-
         c = self.config
         split = self.pipeline_split_stage()
         b = enc_tokens.shape[0]
@@ -547,6 +660,15 @@ class T5Model:
             "dec_tokens": dec_tokens.reshape(num_microbatches, mb, -1),
             "targets": targets.reshape(num_microbatches, mb, -1),
         }
+
+        if c.fused_pipeline:
+            enc_entry, dec_entry, f_stage, last_fn = self._fused_pipeline_fns(
+                split, enc_tokens.shape[1], dec_tokens.shape[1]
+            )
+            return self._run_encdec_fwd_bwd(
+                enc_entry, None, dec_entry, None, last_fn,
+                params, mbs, split, fused_stage_fn=f_stage,
+            )
 
         def enc_entry(prm, m):
             return self._embed(prm, m["enc_tokens"], "enc_pos_embedding")
@@ -587,6 +709,30 @@ class T5Model:
             per_token = self._per_token_ce(prm, x, m["targets"])
             return jnp.mean(per_token)
 
+        return self._run_encdec_fwd_bwd(
+            enc_entry, enc_stage, dec_entry, dec_stage, last_fn,
+            params, mbs, split,
+        )
+
+    def _run_encdec_fwd_bwd(self, enc_entry, enc_stage, dec_entry,
+                            dec_stage, last_fn, params, mbs, split,
+                            fused_stage_fn=None):
+        """Dispatch the enc-dec fwd+bwd schedule and normalise the grads
+        to the optimizer-ready convention (shared tail of
+        :meth:`pipeline_grads` for the fused and two-stream paths)."""
+        import functools
+
+        from apex_tpu.transformer import parallel_state
+        from apex_tpu.transformer.enums import ModelType
+        from apex_tpu.transformer.pipeline_parallel import (
+            get_forward_backward_func,
+            sync_replicated_grads,
+        )
+        from apex_tpu.transformer.pipeline_parallel.schedules import (
+            _fwd_bwd_encdec,
+        )
+
+        c = self.config
         pp = jax.lax.axis_size(PIPELINE_PARALLEL_AXIS)
         if parallel_state.get_pipeline_model_parallel_split_rank() is not None:
             fwd_bwd = get_forward_backward_func(
@@ -595,9 +741,11 @@ class T5Model:
             )
         else:
             fwd_bwd = functools.partial(_fwd_bwd_encdec, split_stage=split)
+        kw = ({"fused_stage_fn": fused_stage_fn}
+              if fused_stage_fn is not None else {})
         losses, grads = fwd_bwd(
             enc_entry, enc_stage, dec_entry, dec_stage, last_fn,
-            params, mbs, remat=c.remat,
+            params, mbs, remat=c.remat, **kw,
         )
         grads = sync_replicated_grads(grads, self.pipeline_param_specs())
         loss = jax.lax.pmean(jnp.mean(losses), DATA_PARALLEL_AXIS)

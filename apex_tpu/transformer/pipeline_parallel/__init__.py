@@ -36,6 +36,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     pipeline_1f1b,
     pipeline_1f1b_interleaved,
     pipeline_encdec,
+    pipeline_encdec_fused,
 )
 
 __all__ = [
@@ -43,6 +44,7 @@ __all__ = [
     "pipeline_1f1b",
     "pipeline_1f1b_interleaved",
     "pipeline_encdec",
+    "pipeline_encdec_fused",
     "pipeline_stage_specs",
     "sync_replicated_grads",
     "forward_backward_no_pipelining",

@@ -739,6 +739,118 @@ def pipeline_encdec(
                       axis_name)
 
 
+def pipeline_encdec_fused(
+    enc_entry_fn: Callable[[Any], Any],
+    dec_entry_fn: Callable[[Any], Any],
+    stage_fn: Callable[[Any, Any, jnp.ndarray], Any],
+    last_fn: Callable[[Any, Any], jnp.ndarray],
+    microbatches: Any,
+    split_stage: int,
+    *,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Encoder-decoder pipeline with ONE stage body per tick — the
+    collapse of :func:`pipeline_encdec`'s double-FLOPs cost (reference:
+    the heterogeneous per-rank enc/dec schedule, apex/transformer/
+    pipeline_parallel/schedules/fwd_bwd_pipelining_without_interleaving
+    .py:22-170, which never runs both bodies on one rank).
+
+    :func:`pipeline_encdec` keeps two activation streams and runs BOTH
+    ``enc_stage_fn`` and ``dec_stage_fn`` on every stage every tick,
+    because a mesh-varying ``lax.cond`` lowers to compute-both-and-
+    select.  This schedule instead rides a SINGLE activation stream
+    through one homogeneous ``stage_fn(x, mem, stage)`` whose per-stage
+    *parameters* (already device-varying data under "pp" sharding)
+    select the behaviour:
+
+    - stages ``[0, split_stage)`` hold encoder weights; the model's
+      stage body gates its cross-attention off (multiply by
+      ``stage >= split``) and selects a non-causal mask — both data
+      selects, no second body;
+    - the activation arriving AT ``split_stage`` is the finished
+      encoder output: it is captured as the cross-attention ``mem``
+      stream and the stream is re-entered with ``dec_entry_fn``;
+    - stages ``[split_stage, pp)`` transform the decoder stream against
+      the riding ``mem``.
+
+    Per-tick cost is therefore ONE superset stage body (decoder-shaped:
+    self-attn + gated cross-attn + MLP) instead of encoder body PLUS
+    decoder body, and the ring carries two streams (x, mem) instead of
+    three (xe, xd, mem).  The requirement bought by that: both entry
+    functions must produce the SAME pytree structure/shapes (pad the
+    shorter sequence and mask via the attention's segment ids — the
+    model owns that, e.g. ``T5Model`` with ``fused_pipeline=True``).
+
+    Timing is identical to :func:`pipeline_encdec`: microbatch ``m``
+    enters stage 0 at tick ``m``, is captured/re-entered at
+    ``split_stage`` at tick ``m + split_stage``, and exits stage
+    ``pp - 1`` at tick ``m + pp - 1``; the head runs once per
+    microbatch after the scan.  Differentiate through the result for
+    the reverse pipeline.
+    """
+    pp = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    if not (1 <= split_stage < pp):
+        raise ValueError(
+            f"split_stage ({split_stage}) must be in [1, pp) — at least "
+            f"one encoder and one decoder stage (pp={pp})"
+        )
+    num_micro = jax.tree.leaves(microbatches)[0].shape[0]
+    ticks = num_micro + pp - 1
+
+    mb0 = _index_microbatch(microbatches, 0)
+    ze = enc_entry_fn(mb0)
+    zd = dec_entry_fn(mb0)
+    e_shapes = [(a.shape, a.dtype) for a in jax.tree.leaves(ze)]
+    d_shapes = [(a.shape, a.dtype) for a in jax.tree.leaves(zd)]
+    if e_shapes != d_shapes:
+        raise ValueError(
+            "pipeline_encdec_fused needs enc_entry_fn and dec_entry_fn "
+            f"to emit identical pytrees (got {e_shapes} vs {d_shapes}); "
+            "pad the shorter stream to a common shape and mask via "
+            "attention segment ids, or use pipeline_encdec"
+        )
+    zeros_x = _ensure_varying(jax.tree.map(lambda a: a * 0, ze), axis_name)
+    zeros_mem = zeros_x
+
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+    stash0 = _make_stash(zeros_x, num_micro)
+
+    def tick(carry, t):
+        x, mem, stash = carry
+        mb_enc = _index_microbatch(
+            microbatches, jnp.minimum(t, num_micro - 1)
+        )
+        x_in = _where_tree(stage == 0, enc_entry_fn(mb_enc), x)
+        # the microbatch arriving at the split stage this tick entered
+        # the ring split_stage ticks ago
+        dec_mb_idx = jnp.clip(t - split_stage, 0, num_micro - 1)
+        mb_dec = _index_microbatch(microbatches, dec_mb_idx)
+        at_split = stage == split_stage
+        # the incoming activation at the split IS the finished encoder
+        # output: capture it as this microbatch's cross-attention
+        # memory, then re-enter the stream with the decoder embedding
+        mem = _where_tree(at_split, x_in, mem)
+        x_in = _where_tree(at_split, dec_entry_fn(mb_dec), x_in)
+
+        y = body(x_in, mem, stage)
+
+        out_idx = jnp.maximum(t - (pp - 1), 0)
+        take = (stage == pp - 1) & (t >= pp - 1)
+        stash = _stash_add(stash, y, out_idx, take)
+
+        x = send_forward(y, axis_name)
+        mem = send_forward(mem, axis_name)
+        return (x, mem, stash), None
+
+    (_, _, stash), _ = lax.scan(
+        tick, (zeros_x, zeros_mem, stash0), jnp.arange(ticks)
+    )
+    return _head_pass(last_fn, stash, microbatches, stage == pp - 1,
+                      axis_name)
+
+
 def forward_backward_no_pipelining(
     first_fn: Callable,
     stage_fn: Callable,
@@ -917,6 +1029,7 @@ def _fwd_bwd_encdec(
     *,
     axis_name: str = PIPELINE_PARALLEL_AXIS,
     remat: bool = True,
+    fused_stage_fn: Optional[Callable] = None,
 ) -> tuple:
     """Encoder-decoder pipeline in the dispatched ``(losses, grads)``
     contract: :func:`pipeline_encdec` differentiated through one vjp
@@ -924,10 +1037,24 @@ def _fwd_bwd_encdec(
     enc-dec path likewise schedules without interleaving,
     schedules/common.py:18-108).  Params are cast varying over the data
     axes so grads are shard-local, the family's shared dp convention
-    (see :func:`_fwd_bwd_no_pipelining`)."""
+    (see :func:`_fwd_bwd_no_pipelining`).
+
+    ``fused_stage_fn(params, x, mem, stage)``, if given, routes through
+    :func:`pipeline_encdec_fused` — one homogeneous stage body per tick
+    instead of both enc and dec bodies; ``enc_stage_fn``/``dec_stage_fn``
+    are then ignored (pass ``None``)."""
     params = _cast_varying(params, _vma_union(microbatches))
 
     def losses_of(prm):
+        if fused_stage_fn is not None:
+            return pipeline_encdec_fused(
+                lambda mb: enc_entry_fn(prm, mb),
+                lambda mb: dec_entry_fn(prm, mb),
+                lambda x, mem, stage: fused_stage_fn(prm, x, mem, stage),
+                lambda y, mb: last_fn(prm, y, mb),
+                microbatches, split_stage,
+                axis_name=axis_name, remat=remat,
+            )
         return pipeline_encdec(
             lambda mb: enc_entry_fn(prm, mb),
             lambda x: enc_stage_fn(prm, x),

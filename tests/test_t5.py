@@ -112,16 +112,18 @@ def test_t5_grads_finite():
 
 
 @pytest.mark.parametrize("remat", [False, True])
-def test_t5_pipeline_matches_sequential(remat):
+@pytest.mark.parametrize("fused", [True, False])
+def test_t5_pipeline_matches_sequential(remat, fused):
     """pp=4 (2 encoder + 2 decoder stages) enc-dec pipeline == the
-    sequential loss, values and grads."""
+    sequential loss, values and grads — both the one-body-per-tick
+    fused schedule (default) and the two-stream fallback."""
     mesh = parallel_state.initialize_model_parallel(
         pipeline_model_parallel_size_=4,
         pipeline_model_parallel_split_rank_=2,
     )
     try:
         enc, dec, tgt = _data(b=8)
-        model = T5Model(small_config(remat=remat))
+        model = T5Model(small_config(remat=remat, fused_pipeline=fused))
         params = model.init(jax.random.PRNGKey(0))
 
         # sequential reference on the dp-only view of the same mesh
@@ -196,9 +198,11 @@ def test_t5_policy_driven():
         parallel_state.destroy_model_parallel()
 
 
-def test_t5_pipeline_grads_matches_gpipe():
+@pytest.mark.parametrize("fused", [True, False])
+def test_t5_pipeline_grads_matches_gpipe(fused):
     """T5 fwd+bwd through the dispatched enc-dec schedule ==
-    jax.grad of pipeline_loss (+ shared-param sync + dp pmean)."""
+    jax.grad of pipeline_loss (+ shared-param sync + dp pmean) — both
+    the fused default and the two-stream fallback."""
     from apex_tpu.transformer.pipeline_parallel import sync_replicated_grads
 
     mesh = parallel_state.initialize_model_parallel(
@@ -206,7 +210,7 @@ def test_t5_pipeline_grads_matches_gpipe():
         pipeline_model_parallel_split_rank_=1,
     )
     try:
-        cfg = small_config()
+        cfg = small_config(fused_pipeline=fused)
         model = T5Model(cfg)
         params = model.pipeline_params(model.init(jax.random.PRNGKey(0)))
         ks = jax.random.split(jax.random.PRNGKey(3), 3)

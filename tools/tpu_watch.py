@@ -1,0 +1,137 @@
+"""Opportunistic TPU capture daemon for a wedged axon chip claim.
+
+The axon pool's single-chip grant can wedge for >1h after a bad client
+teardown (round-3 post-mortem); rounds 3 and 4 both lost their gate
+window to it.  This watcher inverts the problem: instead of probing only
+inside the bench's fixed budget at gate time, it probes cheaply all
+round and fires the full capture the moment the claim frees up.
+
+Loop:
+  1. probe (``bench.py --child probe``) with SIGTERM-first teardown
+  2. on TPU contact: run the full ``bench.py`` pipeline (which persists
+     ``LAST_TPU_BENCH.json`` + ``BENCH_EXTRA.json``), then the kernel
+     sweep (``tools/kernel_validation.py`` -> ``KERNELS_TPU.json``),
+     write ``BENCH_WATCH.json`` with the headline line, and exit 0
+  3. on failure: sleep ``--interval`` (default 420 s) and retry until
+     ``--deadline-s`` (default 9 h), then exit 3
+
+A lock file (``/tmp/apex_tpu_watch.lock``) guards against two TPU
+clients contending for the one claim; anything else that wants the chip
+must check it.  Exit codes: 0 captured, 3 deadline, 4 lock held.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOCK = "/tmp/apex_tpu_watch.lock"
+PY = sys.executable
+
+
+def log(*a):
+    print(f"[tpu_watch {time.strftime('%H:%M:%S')}]", *a, flush=True)
+
+
+def run(args, timeout, grace=60):
+    """SIGTERM-first bounded subprocess (never immediate SIGKILL: a hard
+    kill of a client holding the chip claim is what wedges the pool)."""
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, cwd=REPO)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            out, err = proc.communicate(timeout=grace)
+        except subprocess.TimeoutExpired:
+            log("child ignored SIGTERM; SIGKILL (claim may wedge)")
+            proc.kill()
+            out, err = proc.communicate()
+        return -1, out, err
+
+
+def probe(timeout=120):
+    rc, out, err = run([PY, os.path.join(REPO, "bench.py"),
+                        "--child", "probe"], timeout)
+    if rc != 0:
+        return None
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            d = json.loads(line)
+            return d.get("platform")
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def main():
+    interval = 420
+    deadline_s = 9 * 3600
+    for i, a in enumerate(sys.argv):
+        if a == "--interval":
+            interval = int(sys.argv[i + 1])
+        if a == "--deadline-s":
+            deadline_s = int(sys.argv[i + 1])
+
+    if os.path.exists(LOCK):
+        log(f"lock {LOCK} present; refusing to start a second TPU client")
+        return 4
+    with open(LOCK, "w") as f:
+        f.write(str(os.getpid()))
+    t0 = time.time()
+    attempt = 0
+    try:
+        while time.time() - t0 < deadline_s:
+            attempt += 1
+            plat = probe()
+            if plat and plat != "cpu":
+                log(f"chip contact on attempt {attempt} ({plat}); "
+                    "running full bench")
+                # Full pipeline: probe+gpt+extras, persists
+                # LAST_TPU_BENCH.json on TPU success.
+                rc, out, err = run([PY, os.path.join(REPO, "bench.py")],
+                                   3600, grace=90)
+                sys.stderr.write((err or "")[-3000:])
+                line = None
+                for ln in reversed((out or "").strip().splitlines()):
+                    try:
+                        line = json.loads(ln)
+                        break
+                    except json.JSONDecodeError:
+                        continue
+                captured = bool(line) and line.get("platform") not in (
+                    None, "cpu")
+                with open(os.path.join(REPO, "BENCH_WATCH.json"), "w") as f:
+                    json.dump({"captured": captured, "attempt": attempt,
+                               "bench_rc": rc, "result": line}, f, indent=1)
+                if captured:
+                    log("bench captured on TPU; running kernel sweep")
+                    rc2, out2, err2 = run(
+                        [PY, os.path.join(REPO, "tools",
+                                          "kernel_validation.py")],
+                        2400, grace=90)
+                    log(f"kernel sweep rc={rc2}")
+                    sys.stderr.write((err2 or "")[-2000:])
+                    return 0
+                log(f"bench ran but no TPU result (rc={rc}); continuing")
+            else:
+                log(f"attempt {attempt}: no chip "
+                    f"({(time.time() - t0) / 60:.0f} min elapsed)")
+            time.sleep(interval)
+        log("deadline reached without capture")
+        return 3
+    finally:
+        try:
+            os.remove(LOCK)
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
