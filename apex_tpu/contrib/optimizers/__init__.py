@@ -4,6 +4,8 @@
 from apex_tpu.contrib.optimizers.distributed import (
     DistributedFusedAdam,
     DistributedFusedLAMB,
+    reestablish_replicated,
 )
 
-__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]
+__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
+           "reestablish_replicated"]

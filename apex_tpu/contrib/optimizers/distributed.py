@@ -42,7 +42,37 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
     all_gather_invariant,
 )
 
-__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]
+__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
+           "reestablish_replicated"]
+
+
+def reestablish_replicated(params: Any, param_specs: Any,
+                           axes: Tuple[str, ...] = ("pp", "tp")) -> Any:
+    """Re-mark model-axis-replicated params invariant after a ZeRO step.
+
+    Composing the sharded optimizer with pipeline/tensor parallelism
+    flattens replicated leaves (embeddings, norms) into the same flat
+    buffer as pp/tp-sharded layers, so the all-gathered params come back
+    typed varying over those axes even though replicated leaves carry
+    identical values on every rank (their grads were synced before the
+    step).  A pmean over the missing axes is a numeric no-op that
+    restores the invariant type so ``out_specs`` like ``P()`` typecheck.
+    Call inside shard_map on the params returned by :meth:`step`."""
+    from apex_tpu.transformer.parallel_state import spec_axis_names
+
+    def fix(p, s):
+        names = spec_axis_names(s)
+        for ax in axes:
+            try:
+                varying = ax in jax.typeof(p).vma
+            except Exception:
+                varying = True
+            if ax not in names and varying:
+                p = lax.pmean(p, ax)
+        return p
+
+    return jax.tree.map(fix, params, param_specs,
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 class _FlatMeta:
@@ -141,8 +171,17 @@ class _DistributedOptimizer:
             "exp_avg_sq": jnp.zeros((shard_size,), jnp.float32),
         }
 
-    def state_specs(self) -> dict:
-        ax = self._shard_axis
+    def state_specs(self, model_axes: Tuple[str, ...] = ()) -> dict:
+        """shard_map specs for the sharded state.
+
+        ``model_axes``: mesh axes the *params* are sharded over (e.g.
+        ``("pp", "tp")`` when composing ZeRO with pipeline/tensor
+        parallelism).  Each (pp, tp) position runs its own independent
+        dp-sharded flat buffer over its local params, so the state is
+        varying over those axes too — the spec must say so or
+        shard_map's varying-mesh-axes check rejects the program."""
+        ax = ((*model_axes, self._shard_axis) if model_axes
+              else self._shard_axis)
         specs = {k: P(ax) for k in self._extra_init(1)}
         specs["step"] = P()
         specs["master"] = P(ax)

@@ -598,7 +598,15 @@ class GPTModel:
         for deep gradient accumulation.  Same placement contract as
         :meth:`pipeline_loss`; the returned grads already have the
         shared-param sync AND the dp pmean applied — step the optimizer
-        with them directly (do not psum over dp again)."""
+        with them directly (do not psum over dp again).
+
+        MoE caveat (same as :meth:`pipeline_loss`): the pipeline stage
+        body drops the router load-balance aux loss and router z-loss —
+        the schedule's loss is the CE term only, so MoE models trained
+        under pp>1 get no load-balance/z-loss gradient.  Train MoE with
+        pp=1 (the sequential path threads both terms) or accept
+        CE-only routing pressure; threading per-stage aux sums through
+        the 1F1B carry is future work."""
         from apex_tpu.transformer.pipeline_parallel import (
             get_forward_backward_func,
             sync_replicated_grads,

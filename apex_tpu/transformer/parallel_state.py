@@ -39,6 +39,7 @@ __all__ = [
     "model_parallel_is_initialized",
     "destroy_model_parallel",
     "get_mesh",
+    "spec_axis_names",
     "DATA_PARALLEL_AXIS",
     "PIPELINE_PARALLEL_AXIS",
     "CONTEXT_PARALLEL_AXIS",
@@ -150,6 +151,21 @@ def initialize_model_parallel(
         ),
     )
     return _MESH
+
+
+def spec_axis_names(spec) -> List[str]:
+    """Flatten a ``PartitionSpec`` into the mesh-axis names it mentions
+    (entries may be a name, a tuple of names, or None).  The one
+    definition of "which axes shard this leaf" shared by the replicated-
+    param sync helpers and the tests — spec-shape semantics must not
+    diverge between them."""
+    names: List[str] = []
+    for entry in spec:
+        if isinstance(entry, (tuple, list)):
+            names.extend(entry)
+        elif entry is not None:
+            names.append(entry)
+    return names
 
 
 def model_parallel_is_initialized() -> bool:

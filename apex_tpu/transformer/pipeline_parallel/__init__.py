@@ -102,14 +102,10 @@ def sync_replicated_grads(
     Call inside shard_map, after ``jax.grad``."""
     from jax import lax
 
+    from apex_tpu.transformer.parallel_state import spec_axis_names
+
     def fix(g, s):
-        names = []
-        for entry in s:
-            if isinstance(entry, (tuple, list)):
-                names.extend(entry)
-            elif entry is not None:
-                names.append(entry)
-        if axis_name in names:
+        if axis_name in spec_axis_names(s):
             return g
         try:
             if axis_name not in jax.typeof(g).vma:

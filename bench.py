@@ -776,22 +776,35 @@ def main():
     on_tpu = False
     if platform is not None and platform != "cpu":
         for retry in range(2):
+            # the probe loop may have spent down to the reserve: clamp
+            # the measurement child to the remaining budget so probe +
+            # 2 children + sleep can never overrun TOTAL_BUDGET
+            child_budget = min(CHILD_TIMEOUT, max(0, budget_left()))
+            if child_budget < 120:
+                errors.append(
+                    f"tpu-gpt[{retry}]: skipped, only "
+                    f"{child_budget:.0f}s budget left")
+                break
             ok, result, err = _run_child(
-                ["--child", "gpt", "--platform", platform], CHILD_TIMEOUT
+                ["--child", "gpt", "--platform", platform], child_budget
             )
             if ok:
                 on_tpu = True
                 break
             errors.append(f"tpu-gpt[{retry}]: {err[-300:]}")
             result = None
-            if retry == 0:
+            if retry == 0 and budget_left() > 150:
                 time.sleep(30)
 
     if result is None:
         # TPU unreachable or measurement failed: CPU fallback so the
-        # bench still emits a valid, clearly-marked measurement
+        # bench still emits a valid, clearly-marked measurement.  Clamp
+        # to the remaining budget with a 300s floor (the CPU child at
+        # the fallback config finishes well inside it) so this leg
+        # cannot extend a fully-spent gate window by CHILD_TIMEOUT
         ok, result, err = _run_child(
-            ["--child", "gpt", "--platform", "cpu"], CHILD_TIMEOUT
+            ["--child", "gpt", "--platform", "cpu"],
+            min(CHILD_TIMEOUT, max(300, budget_left())),
         )
         if not ok:
             errors.append(f"cpu-gpt: {err[-300:]}")

@@ -57,6 +57,15 @@ MB_ROWS = 8
 VOCAB = 1024
 
 
+def set_config(hidden=256, mb_rows=8, vocab=1024, layers_per_stage=2):
+    """Swap the sweep's model scale (the r5 crossover sweep runs a
+    hidden=1024 / 64-row config where per-tick activations dominate the
+    constant workspace, making the GPipe-vs-1F1B crossover visible)."""
+    global HIDDEN, MB_ROWS, VOCAB, LAYERS_PER_STAGE
+    HIDDEN, MB_ROWS, VOCAB, LAYERS_PER_STAGE = (
+        hidden, mb_rows, vocab, layers_per_stage)
+
+
 def _setup(num_micro: int):
     """Model, specs, and data shared by both schedules' measurements —
     one definition so the GPipe and 1F1B rows stay comparable."""
@@ -208,6 +217,14 @@ def measure_interleaved(num_micro: int, V: int = 2) -> dict:
         parallel_state.destroy_model_parallel()
 
 
+def _config_doc():
+    return {
+        "pp": PP, "hidden": HIDDEN, "mb_rows": MB_ROWS,
+        "vocab": VOCAB, "layers_per_stage": LAYERS_PER_STAGE,
+        "activation_mb": MB_ROWS * HIDDEN * 4 / 1e6,
+    }
+
+
 def main():
     rows = []
     for remat in (True, False):
@@ -223,14 +240,80 @@ def main():
         row = measure_interleaved(num_micro)
         rows.append(row)
         print(json.dumps(row))
-    # scaling diagnosis: slope of temp vs num_micro, per remat mode
+    small_config = _config_doc()
+
+    # ---- offset decomposition (r4 verdict: the ~1.5 MB constant the
+    # 1f1b temp level sits above gpipe+remat at the small config).
+    # Three controlled variants at micro=8 attribute it to measured
+    # components rather than guesses: (a) vocab=1 removes the LM-head
+    # stash + dhead workspace; (b) mb_rows doubled scales activation-
+    # proportional terms; (c) gpipe+remat under the same variants.
+    decomp = []
+    for tag, hidden, mb_rows, vocab in (
+        ("base", 256, 8, 1024),
+        ("no_head", 256, 8, 1),
+        ("2x_rows", 256, 16, 1024),
+    ):
+        set_config(hidden=hidden, mb_rows=mb_rows, vocab=vocab)
+        a = measure_1f1b(8)
+        b = measure(8, True)
+        decomp.append({"variant": tag, "config": _config_doc(),
+                       "1f1b_temp_mb": a["temp_mb"],
+                       "gpipe_remat_temp_mb": b["temp_mb"],
+                       "offset_mb": round(a["temp_mb"] - b["temp_mb"], 3)})
+        print(json.dumps(decomp[-1]))
+    set_config()
+
+    # ---- crossover sweep: hidden=1024 / 64-row microbatches, where a
+    # tick's activation (64*1024*4 = 256 KB) dwarfs the constant
+    # workspace.  GPipe+remat stashes one activation per tick
+    # (num_micro + pp - 1 of them), 1F1B keeps O(pp) in flight — the
+    # curves must cross as num_micro grows.
+    set_config(hidden=1024, mb_rows=64, vocab=1024)
+    large_rows = []
+    for num_micro in (4, 8, 16, 32, 64):
+        row = measure(num_micro, True)
+        large_rows.append(row)
+        print(json.dumps(row))
+        row = measure_1f1b(num_micro)
+        large_rows.append(row)
+        print(json.dumps(row))
+    large_config = _config_doc()
+    set_config()
+    crossover = None
+    for m in (4, 8, 16, 32, 64):
+        g = next(r["temp_mb"] for r in large_rows
+                 if r["schedule"] == "gpipe" and r["num_micro"] == m)
+        o = next(r["temp_mb"] for r in large_rows
+                 if r["schedule"] == "1f1b" and r["num_micro"] == m)
+        if o < g:
+            crossover = m
+            break
+
     doc = {
-        "config": {
-            "pp": PP, "hidden": HIDDEN, "mb_rows": MB_ROWS,
-            "layers_per_stage": LAYERS_PER_STAGE,
-            "activation_mb": MB_ROWS * HIDDEN * 4 / 1e6,
-        },
+        "config": small_config,
         "rows": rows,
+        "offset_decomposition": decomp,
+        "large_config": large_config,
+        "large_rows": large_rows,
+        "crossover_num_micro": crossover,
+        "notes": (
+            "large sweep: gpipe+remat temp grows ~one activation per tick "
+            "(num_micro + pp - 1), 1f1b holds O(pp) stage inputs; "
+            "crossover_num_micro is the first measured num_micro where "
+            "1f1b temp < gpipe+remat temp at the large config (r5 "
+            "capture: gpipe 17.8->76.5 MB over micro 4->64 vs 1f1b flat "
+            "at 39.1 MB, crossing at micro=32). The small-config ~1.5 MB "
+            "constant offset decomposes per offset_decomposition: "
+            "removing the LM head (no_head) cuts it ~35% (head-grad "
+            "buffers held across the fwd+bwd scan), while doubling "
+            "activation rows (2x_rows) leaves it ~flat — the offset is "
+            "per-program vjp workspace (1f1b's single scan carries both "
+            "fwd and bwd temporaries), constant in num_micro AND in "
+            "activation size, i.e. exactly the term that stops "
+            "mattering at production scale where the large sweep's "
+            "per-tick activations dominate."
+        ),
     }
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "PIPELINE_MEMORY.json")

@@ -56,9 +56,15 @@ def run(args, timeout, grace=60):
         return -1, out, err
 
 
-def probe(timeout=120):
+def probe(timeout=1500):
+    """Long-window probe: the axon pool queues claim requests, so a
+    claimant that WAITS converts the wedge's expiry into an immediate
+    grant — far better than short probes that must be SIGKILLed (a kill
+    racing a just-arrived grant is exactly what re-wedges the pool).
+    The child exits cleanly on grant, releasing the claim for the bench
+    run that follows."""
     rc, out, err = run([PY, os.path.join(REPO, "bench.py"),
-                        "--child", "probe"], timeout)
+                        "--child", "probe"], timeout, grace=120)
     if rc != 0:
         return None
     for line in reversed((out or "").strip().splitlines()):
@@ -79,11 +85,32 @@ def main():
         if a == "--deadline-s":
             deadline_s = int(sys.argv[i + 1])
 
-    if os.path.exists(LOCK):
-        log(f"lock {LOCK} present; refusing to start a second TPU client")
-        return 4
-    with open(LOCK, "w") as f:
-        f.write(str(os.getpid()))
+    # O_EXCL create beats check-then-create races; a stale lock (holder
+    # PID dead — e.g. the watcher was SIGKILLed so its finally never
+    # ran) is taken over rather than blocking captures forever
+    while True:
+        try:
+            fd = os.open(LOCK, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            break
+        except FileExistsError:
+            try:
+                holder = int(open(LOCK).read().strip())
+                os.kill(holder, 0)  # ProcessLookupError if dead
+            except (ValueError, ProcessLookupError, FileNotFoundError):
+                # stale: take it over and retry the O_EXCL create
+                log(f"stale lock {LOCK}; taking over")
+                try:
+                    os.remove(LOCK)
+                except FileNotFoundError:
+                    pass
+            else:
+                # holder alive (PermissionError would also mean alive,
+                # but this watcher always runs as one user)
+                log(f"lock {LOCK} held by live pid {holder}; refusing "
+                    "to start a second TPU client")
+                return 4
     t0 = time.time()
     attempt = 0
     try:
