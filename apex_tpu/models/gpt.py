@@ -518,6 +518,25 @@ class GPTModel:
             ),
         }
 
+    def _pp_stack(self, x, layers):
+        """Run one stacked-layer slice over the pipeline activation
+        stream — shared by the GPipe (:meth:`pipeline_loss`) and
+        1F1B/interleaved (:meth:`pipeline_1f1b_grads`) stage bodies so
+        the aux-threading semantics cannot diverge.  The stream is
+        ``{"h": hidden, "aux": scalar}`` for MoE models (the aux-loss
+        accumulator rides the ppermute ring with its microbatch), plain
+        hidden otherwise."""
+
+        def body(h, lp):
+            out, aux = self._layer(lp, h, None)
+            return out, aux
+
+        if self.moe is not None:
+            out, auxs = jax.lax.scan(body, x["h"], layers)
+            return {"h": out, "aux": x["aux"] + jnp.sum(auxs)}
+        out, _ = jax.lax.scan(body, x, layers)
+        return out
+
     def pipeline_loss(
         self,
         params: Dict[str, Any],
@@ -546,21 +565,26 @@ class GPTModel:
             "targets": targets.reshape(num_microbatches, mb, s),
         }
 
+        moe = self.moe is not None
+
         def first_fn(m):
             x = self.embedding.apply(params["embedding"], m["tokens"])
             x = x + self._pos_slice(params, s)[None, :, :].astype(x.dtype)
-            return x.astype(c.compute_dtype)
+            x = x.astype(c.compute_dtype)
+            # MoE: the activation stream carries a per-microbatch aux
+            # accumulator (schedules are pytree-generic, so the scalar
+            # rides the ppermute ring with its microbatch for free).
+            # Derive the zero from x so it carries x's varying-mesh-axes
+            # type: a plain 0.0 constant is mesh-invariant and the
+            # backward would reject the varying cotangent
+            return ({"h": x, "aux": jnp.sum(x).astype(jnp.float32) * 0}
+                    if moe else x)
 
         def stage_fn(x):
-            # MoE aux loss is not accumulated through the pipeline path
-            def body(h, lp):
-                out, _aux = self._layer(lp, h, None)
-                return out, None
-
-            out, _ = jax.lax.scan(body, x, params["layers"])
-            return out
+            return self._pp_stack(x, params["layers"])
 
         def last_fn(x, m):
+            x, aux = (x["h"], x["aux"]) if moe else (x, None)
             x = fused_layer_norm_affine(
                 x.astype(jnp.float32),
                 params["final_ln"]["scale"],
@@ -569,7 +593,12 @@ class GPTModel:
                 eps=c.layernorm_epsilon,
             ).astype(c.compute_dtype)
             per_token = self._per_token_ce(params, x, m["targets"])
-            return jnp.mean(per_token)
+            loss = jnp.mean(per_token)
+            if moe:
+                # same weighting as the sequential path (loss():
+                # ce + moe_aux_weight * summed aux), per microbatch
+                loss = loss + c.moe_aux_weight * aux
+            return loss
 
         per_micro = pipeline(
             first_fn, stage_fn, last_fn, mbs, remat=c.remat
@@ -600,13 +629,13 @@ class GPTModel:
         shared-param sync AND the dp pmean applied — step the optimizer
         with them directly (do not psum over dp again).
 
-        MoE caveat (same as :meth:`pipeline_loss`): the pipeline stage
-        body drops the router load-balance aux loss and router z-loss —
-        the schedule's loss is the CE term only, so MoE models trained
-        under pp>1 get no load-balance/z-loss gradient.  Train MoE with
-        pp=1 (the sequential path threads both terms) or accept
-        CE-only routing pressure; threading per-stage aux sums through
-        the 1F1B carry is future work."""
+        MoE: the activation stream carries a per-microbatch aux-loss
+        accumulator through the ring (the schedules are pytree-generic),
+        so the router load-balance aux and z-loss DO reach the loss and
+        the router gradients under pp>1 — per-microbatch accumulation
+        semantics, same as grad accumulation (each microbatch's
+        balance statistics are its own; the sequential whole-batch
+        ``loss()`` computes one global statistic instead)."""
         from apex_tpu.transformer.pipeline_parallel import (
             get_forward_backward_func,
             sync_replicated_grads,
@@ -628,18 +657,20 @@ class GPTModel:
             "targets": targets.reshape(num_microbatches, mb, s),
         }
 
+        moe = self.moe is not None
+
         def first_fn(prm, m):
             x = self.embedding.apply(prm["embedding"], m["tokens"])
             x = x + self._pos_slice(prm, s)[None, :, :].astype(x.dtype)
-            return x.astype(c.compute_dtype)
-
-        def layer_body(h, lp):
-            out, _aux = self._layer(lp, h, None)
-            return out, None
+            x = x.astype(c.compute_dtype)
+            # MoE: per-microbatch aux accumulator rides the stream; the
+            # zero derives from x to carry its varying-mesh-axes type
+            # (see pipeline_loss)
+            return ({"h": x, "aux": jnp.sum(x).astype(jnp.float32) * 0}
+                    if moe else x)
 
         def stage_fn(prm, x):
-            out, _ = jax.lax.scan(layer_body, x, prm["layers"])
-            return out
+            return self._pp_stack(x, prm["layers"])
 
         def chunk_fn(prm, x, v):
             # local chunk v: (V, 1, per, ...) sliced at [v, 0]
@@ -647,10 +678,10 @@ class GPTModel:
                 lambda l: jax.lax.dynamic_index_in_dim(l, v, 0, False)[0],
                 prm["layers"],
             )
-            out, _ = jax.lax.scan(layer_body, x, chunk)
-            return out
+            return self._pp_stack(x, chunk)
 
         def last_fn(prm, x, m):
+            x, aux = (x["h"], x["aux"]) if moe else (x, None)
             x = fused_layer_norm_affine(
                 x.astype(jnp.float32),
                 prm["final_ln"]["scale"],
@@ -659,7 +690,10 @@ class GPTModel:
                 eps=c.layernorm_epsilon,
             ).astype(c.compute_dtype)
             per_token = self._per_token_ce(prm, x, m["targets"])
-            return jnp.mean(per_token)
+            loss = jnp.mean(per_token)
+            if moe:
+                loss = loss + c.moe_aux_weight * aux
+            return loss
 
         fwd_bwd = get_forward_backward_func(
             virtual_pipeline_model_parallel_size=num_model_chunks,

@@ -166,7 +166,7 @@ def child_gpt(platform: str):
     WARMUP = 2
     STEPS = 10 if on_tpu else 4
 
-    def build_step(fast: bool):
+    def build_step(fast: bool, **cfg_over):
         if parallel_state.model_parallel_is_initialized():
             parallel_state.destroy_model_parallel()
         mesh = parallel_state.initialize_model_parallel()
@@ -174,8 +174,7 @@ def child_gpt(platform: str):
             max_position_embeddings=SEQ,
             compute_dtype=jnp.bfloat16 if fast else jnp.float32,
             attention_impl=(None if on_tpu else "xla") if fast else "xla",
-            remat=True,
-            **cfg_common,
+            **{**cfg_common, "remat": True, **cfg_over},
         )
         model = GPTModel(cfg)
         params = model.init(jax.random.PRNGKey(0))
@@ -214,8 +213,8 @@ def child_gpt(platform: str):
             params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
         return place(params, specs), place(opt_state, opt_specs), step, n_params
 
-    def run(fast: bool, batch: int):
-        params, opt_state, step, n_params = build_step(fast)
+    def run(fast: bool, batch: int, **cfg_over):
+        params, opt_state, step, n_params = build_step(fast, **cfg_over)
         key = jax.random.PRNGKey(1)
         tokens = jax.random.randint(
             key, (batch, SEQ), 0, cfg_common["vocab_size"]
@@ -266,6 +265,25 @@ def child_gpt(platform: str):
     if fast == 0.0:
         raise RuntimeError("fast path failed at every batch") from last_err
 
+    # in-process A/B of the r3/r4 perf levers (PROFILE_r03.md gap
+    # decomposition): same process because chip-state drift between
+    # processes is +-4% on this tunnel backend.  Each entry is
+    # headline/variant tokens-per-sec, so >1 means the lever helps.
+    ab = {}
+    if on_tpu:
+        for tag, over in (
+            ("fused_ce", {"fused_ce": False}),
+            ("remat", {"remat": False}),
+        ):
+            try:
+                tps_var, _ = run(fast=True, batch=best_batch, **over)
+                ab[f"{tag}_speedup"] = round(fast / tps_var, 3)
+            except AssertionError:
+                raise  # non-finite loss in a variant is a correctness bug
+            except Exception as e:  # OOM (remat off) is informative too
+                ab[f"{tag}_speedup"] = None
+                log(f"ab {tag} variant failed: {str(e)[:160]}")
+
     # model FLOPs per token: 6*N (fwd+bwd matmuls) + 12*L*h*s attention
     flops_per_token = (
         6 * n_params
@@ -290,6 +308,7 @@ def child_gpt(platform: str):
         "steps": STEPS,
         "warmup": WARMUP,
         "ms_per_step": round(best_batch * SEQ / fast * 1e3, 2),
+        **({"ab": ab} if ab else {}),
         **({} if on_tpu else {"note": (
             "cpu fallback (TPU unreachable): bf16 has no CPU matrix "
             "units, so vs_baseline is not representative of TPU"
