@@ -37,6 +37,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     pipeline_1f1b_interleaved,
     pipeline_encdec,
     pipeline_encdec_fused,
+    pipeline_encdec_fused_1f1b,
 )
 
 __all__ = [
@@ -45,6 +46,7 @@ __all__ = [
     "pipeline_1f1b_interleaved",
     "pipeline_encdec",
     "pipeline_encdec_fused",
+    "pipeline_encdec_fused_1f1b",
     "pipeline_stage_specs",
     "sync_replicated_grads",
     "forward_backward_no_pipelining",

@@ -245,6 +245,58 @@ def pipeline(
                       axis_name)
 
 
+def _head_vjp(params, last_fn, y_rec, mb_b, pred, bwd_valid,
+              loss_probe, loss_seed, axis_name):
+    """Gated LM-head vjp shared by the whole 1F1B family: on ``pred``
+    ticks, run ``last_fn``'s vjp seeded with ``loss_seed`` and return
+    ``(loss_m, dparams_head, dy_head)``; otherwise type-matched zeros.
+    Safe in SPMD: ``pred`` depends only on (t, pipeline rank), so every
+    device in a tp group takes the same branch and the head's tp
+    collectives stay consistent within their groups."""
+
+    def head_branch(prm, yy, mb):
+        loss_m, head_vjp = jax.vjp(
+            lambda p_, y_: last_fn(p_, y_, mb), prm, yy
+        )
+        # the seed value is always loss_seed here (the cond predicate
+        # includes bwd_valid); the union with bwd_valid's vma keeps the
+        # branch outputs' types identical to head_zero's
+        seed = _cast_varying(
+            jnp.float32(loss_seed), _vma_union(loss_m, bwd_valid)
+        )
+        dprm, dy_h = head_vjp(seed)
+        return loss_m, dprm, _harden_float0(dy_h, yy)
+
+    def head_zero(prm, yy, mb):
+        return (
+            # the live branch's loss varies over the pipeline axis
+            # (y_rec does); the probe was computed outside the ring
+            _cast_varying(
+                loss_probe * 0, _vma_union(loss_probe) | {axis_name}
+            ),
+            jax.tree.map(lambda p_: p_ * 0, prm),
+            jax.tree.map(lambda a: a * 0, yy),
+        )
+
+    return lax.cond(pred, head_branch, head_zero, params, y_rec, mb_b)
+
+
+def _entry_vjp(params, entry_fn, ct, mb_b, pred, zeros_x):
+    """Gated pipeline-entry (embedding) vjp shared by the 1F1B family:
+    on ``pred`` ticks, pull the entry cotangent ``ct`` into parameter
+    grads; otherwise zeros."""
+
+    def emb_branch(prm, ct_, mb):
+        _, emb_vjp = jax.vjp(lambda p_: entry_fn(p_, mb), prm)
+        (dprm,) = emb_vjp(_soften_int_ct(ct_, zeros_x))
+        return dprm
+
+    def emb_zero(prm, ct_, mb):
+        return jax.tree.map(lambda p_: p_ * 0, prm)
+
+    return lax.cond(pred, emb_branch, emb_zero, params, ct, mb_b)
+
+
 def _bwd_tick(
     *,
     params: Any,
@@ -281,33 +333,9 @@ def _bwd_tick(
     and the input cotangent to ride the reverse ring.
     """
     y_rec, stage_vjp = jax.vjp(apply_fn, params, x_saved)
-
-    def head_branch(prm, yy, mb):
-        loss_m, head_vjp = jax.vjp(
-            lambda p_, y_: last_fn(p_, y_, mb), prm, yy
-        )
-        # the seed value is always loss_seed here (the cond predicate
-        # includes bwd_valid); the union with bwd_valid's vma keeps the
-        # branch outputs' types identical to head_zero's
-        seed = _cast_varying(
-            jnp.float32(loss_seed), _vma_union(loss_m, bwd_valid)
-        )
-        dprm, dy_h = head_vjp(seed)
-        return loss_m, dprm, _harden_float0(dy_h, yy)
-
-    def head_zero(prm, yy, mb):
-        return (
-            # the live branch's loss varies over the pipeline axis
-            # (y_rec does); the probe was computed outside the ring
-            _cast_varying(
-                loss_probe * 0, _vma_union(loss_probe) | {axis_name}
-            ),
-            jax.tree.map(lambda p_: p_ * 0, prm),
-            jax.tree.map(lambda a: a * 0, yy),
-        )
-
-    loss_m, dparams_head, dy_head = lax.cond(
-        is_exit & bwd_valid, head_branch, head_zero, params, y_rec, mb_b
+    loss_m, dparams_head, dy_head = _head_vjp(
+        params, last_fn, y_rec, mb_b, is_exit & bwd_valid, bwd_valid,
+        loss_probe, loss_seed, axis_name,
     )
 
     dy = _where_tree(is_exit, dy_head, bwd_ct)
@@ -315,16 +343,9 @@ def _bwd_tick(
     dparams_stage, dx = stage_vjp(_soften_int_ct(dy, y_rec))
     dx = _harden_float0(dx, x_saved)
 
-    def emb_branch(prm, ct, mb):
-        _, emb_vjp = jax.vjp(lambda p_: first_fn(p_, mb), prm)
-        (dprm,) = emb_vjp(_soften_int_ct(ct, zeros_x))
-        return dprm
-
-    def emb_zero(prm, ct, mb):
-        return jax.tree.map(lambda p_: p_ * 0, prm)
-
-    dparams_emb = lax.cond(is_entry & bwd_valid, emb_branch, emb_zero,
-                           params, dx, mb_b)
+    dparams_emb = _entry_vjp(
+        params, first_fn, dx, mb_b, is_entry & bwd_valid, zeros_x
+    )
 
     dparams = jax.tree.map(
         lambda a, b, c: a + b + c,
@@ -851,6 +872,200 @@ def pipeline_encdec_fused(
                       axis_name)
 
 
+def pipeline_encdec_fused_1f1b(
+    enc_entry_fn: Callable[[Any, Any], Any],
+    dec_entry_fn: Callable[[Any, Any], Any],
+    stage_fn: Callable[[Any, Any, Any, jnp.ndarray], Any],
+    last_fn: Callable[[Any, Any, Any], jnp.ndarray],
+    params: Any,
+    microbatches: Any,
+    split_stage: int,
+    *,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+) -> tuple:
+    """True 1F1B for the fused encoder-decoder pipeline: O(pp)
+    activation memory for enc-dec models (the reference schedules
+    enc-dec ONLY without 1F1B steady-state memory bounds —
+    schedules/common.py:18-108; this goes beyond it).
+
+    Builds on :func:`pipeline_encdec_fused`'s single activation stream
+    (one homogeneous ``stage_fn(params, x, mem, stage)`` body, memory
+    captured at ``split_stage``) and :func:`pipeline_1f1b`'s schedule
+    coordinates (fwd of microbatch ``t - p``, bwd of microbatch
+    ``t - (2pp - 2 - p)``, ``T = M + 2pp - 2`` ticks).  The enc-dec
+    specifics:
+
+    - the saved-state circular buffer holds the full stage input PAIR
+      ``{x, mem}`` (2*pp of them), so each backward tick can re-derive
+      its stage activations by remat exactly as the plain schedule does;
+    - the reverse ring carries the cotangent PAIR ``{dx, dmem}``:
+      ``mem`` passes through decoder stages unchanged, so its cotangent
+      ACCUMULATES stage-by-stage on the way back (each stage adds its
+      local cross-attention contribution);
+    - at the split stage the accumulated ``dmem`` IS the cotangent of
+      the incoming encoder output: it crosses over to ride the ring as
+      ``dx`` into the encoder stages (whose own ``dmem`` is identically
+      zero — their cross-attention is gated off), and the stage's local
+      ``dx`` (the decoder-embedding cotangent) feeds the decoder
+      entry's vjp — the second pipeline entry point, mirroring stage
+      0's encoder-embedding vjp.
+
+    Same contract as :func:`pipeline_1f1b`: returns ``(losses, grads)``
+    with grads = d(mean losses)/d params, shard-local in the data axes,
+    shared-param pp-sync NOT yet applied.
+    """
+    pp = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    if not (1 <= split_stage < pp):
+        raise ValueError(
+            f"split_stage ({split_stage}) must be in [1, pp) (pp={pp})"
+        )
+    num_micro = jax.tree.leaves(microbatches)[0].shape[0]
+    ticks = num_micro + 2 * pp - 2
+    nbuf = 2 * pp
+
+    mb0 = _index_microbatch(microbatches, 0)
+    data_axes = _vma_union(microbatches)
+    params = _cast_varying(params, data_axes | {axis_name})
+
+    x_probe = enc_entry_fn(params, mb0)
+    d_probe = dec_entry_fn(params, mb0)
+    e_shapes = [(a.shape, a.dtype) for a in jax.tree.leaves(x_probe)]
+    d_shapes = [(a.shape, a.dtype) for a in jax.tree.leaves(d_probe)]
+    if e_shapes != d_shapes:
+        raise ValueError(
+            "fused enc-dec 1F1B needs identical entry pytrees (got "
+            f"{e_shapes} vs {d_shapes}); pad the shorter stream (see "
+            "pipeline_encdec_fused)"
+        )
+    zeros_x = _cast_varying(
+        jax.tree.map(lambda a: a * 0, x_probe), {axis_name}
+    )
+    zeros_pair = {"x": zeros_x, "mem": zeros_x}
+    buffer0 = _make_stash(zeros_pair, nbuf)
+    grads0 = jax.tree.map(lambda p_: p_ * 0, params)
+    loss_probe = last_fn(
+        params, jax.tree.map(lambda a: a * 0, x_probe), mb0
+    )
+    losses0 = _cast_varying(
+        jnp.zeros((num_micro,), jnp.float32),
+        _vma_union(loss_probe) | {axis_name},
+    )
+    loss_seed = jnp.float32(1.0 / num_micro)
+    at_split = stage == split_stage
+
+    def apply_pair(prm, pair):
+        return stage_fn(prm, pair["x"], pair["mem"], stage)
+
+    def tick(carry, t):
+        fwd_pair, bwd_pair, buffer, grads, losses = carry
+
+        # ---- forward: microbatch t - p enters/advances ----------------
+        mf = t - stage
+        fwd_valid = (mf >= 0) & (mf < num_micro)
+        mb_f = _index_microbatch(
+            microbatches, jnp.clip(mf, 0, num_micro - 1)
+        )
+        x_in = _where_tree(
+            stage == 0, enc_entry_fn(params, mb_f), fwd_pair["x"]
+        )
+        # the split stage's incoming x IS the finished encoder output:
+        # capture it as this microbatch's memory, re-enter with the
+        # decoder embedding (microbatch index is mf at both entries —
+        # the fused forward puts microbatch m at stage p at tick m + p)
+        mem_in = _where_tree(at_split, x_in, fwd_pair["mem"])
+        x_in = _where_tree(
+            at_split, dec_entry_fn(params, mb_f), x_in
+        )
+        pair_in = {"x": x_in, "mem": mem_in}
+        y = apply_pair(params, pair_in)
+        slot_f = jnp.clip(mf, 0, num_micro - 1) % nbuf
+        buffer = jax.tree.map(
+            lambda b, xi: b.at[slot_f].set(
+                jnp.where(fwd_valid, xi, b[slot_f])
+            ),
+            buffer, pair_in,
+        )
+
+        # ---- backward: microbatch t - (2pp - 2 - p) retires -----------
+        mb_idx = t - (2 * pp - 2 - stage)
+        bwd_valid = (mb_idx >= 0) & (mb_idx < num_micro)
+        mb_c = jnp.clip(mb_idx, 0, num_micro - 1)
+        mb_b = _index_microbatch(microbatches, mb_c)
+        slot_b = mb_c % nbuf
+        pair_saved = jax.tree.map(lambda b: b[slot_b], buffer)
+
+        y_rec, stage_vjp = jax.vjp(apply_pair, params, pair_saved)
+        is_exit = stage == pp - 1
+        loss_m, dparams_head, dy_head = _head_vjp(
+            params, last_fn, y_rec, mb_b, is_exit & bwd_valid,
+            bwd_valid, loss_probe, loss_seed, axis_name,
+        )
+
+        dy = _where_tree(is_exit, dy_head, bwd_pair["x"])
+        dy = _where_tree(bwd_valid, dy, jax.tree.map(jnp.zeros_like, dy))
+        dparams_stage, dpair = stage_vjp(_soften_int_ct(dy, y_rec))
+        dpair = _harden_float0(dpair, pair_saved)
+        dx_local, dmem_local = dpair["x"], dpair["mem"]
+        # mem passes through stages unchanged, so its cotangent is the
+        # local cross-attention contribution PLUS whatever accumulated
+        # downstream (gated like dy: the arriving pair belongs to the
+        # same retiring microbatch)
+        dmem_in = _where_tree(
+            bwd_valid, bwd_pair["mem"],
+            jax.tree.map(jnp.zeros_like, bwd_pair["mem"]),
+        )
+        dmem_total = jax.tree.map(
+            lambda a, b: a + b, dmem_local, dmem_in
+        )
+
+        # entry vjps: encoder embedding at stage 0, decoder embedding
+        # at the split — each seeded with the LOCAL x-cotangent
+        dparams_enc = _entry_vjp(
+            params, enc_entry_fn, dx_local, mb_b,
+            (stage == 0) & bwd_valid, zeros_x,
+        )
+        dparams_dec = _entry_vjp(
+            params, dec_entry_fn, dx_local, mb_b,
+            at_split & bwd_valid, zeros_x,
+        )
+
+        # ring crossover at the split: the accumulated mem cotangent is
+        # the encoder output's cotangent — it becomes the dx riding
+        # into the encoder stages; the mem channel resets below
+        dx_out = _where_tree(at_split, dmem_total, dx_local)
+        dmem_out = _where_tree(
+            at_split, jax.tree.map(jnp.zeros_like, dmem_total),
+            dmem_total,
+        )
+
+        grads = jax.tree.map(
+            lambda g, a, b, c_, d: g + a + b + c_ + d,
+            grads, dparams_stage, dparams_head, dparams_enc, dparams_dec,
+        )
+        losses = losses.at[mb_c].add(
+            jnp.where(is_exit & bwd_valid, loss_m, 0.0)
+        )
+
+        fwd_x, bwd_x = send_forward_recv_backward(
+            y, dx_out, axis_name
+        )
+        fwd_mem, bwd_mem = send_forward_recv_backward(
+            mem_in, dmem_out, axis_name
+        )
+        return ({"x": fwd_x, "mem": fwd_mem},
+                {"x": bwd_x, "mem": bwd_mem},
+                buffer, grads, losses), None
+
+    (_, _, _, grads, losses), _ = lax.scan(
+        tick,
+        (dict(zeros_pair), dict(zeros_pair), buffer0, grads0, losses0),
+        jnp.arange(ticks),
+    )
+    losses = lax.psum(losses, axis_name)
+    return losses, grads
+
+
 def forward_backward_no_pipelining(
     first_fn: Callable,
     stage_fn: Callable,
@@ -1040,21 +1255,19 @@ def _fwd_bwd_encdec(
     (see :func:`_fwd_bwd_no_pipelining`).
 
     ``fused_stage_fn(params, x, mem, stage)``, if given, routes through
-    :func:`pipeline_encdec_fused` — one homogeneous stage body per tick
-    instead of both enc and dec bodies; ``enc_stage_fn``/``dec_stage_fn``
-    are then ignored (pass ``None``)."""
+    the fused one-body-per-tick family — :func:`pipeline_encdec_fused_
+    1f1b`, true 1F1B memory (O(pp) saved stage-input pairs instead of
+    the vjp-through-GPipe tape); ``enc_stage_fn``/``dec_stage_fn`` are
+    then ignored (pass ``None``).  The two-stream fallback below keeps
+    GPipe-memory vjp semantics."""
+    if fused_stage_fn is not None:
+        return pipeline_encdec_fused_1f1b(
+            enc_entry_fn, dec_entry_fn, fused_stage_fn, last_fn,
+            params, microbatches, split_stage, axis_name=axis_name,
+        )
     params = _cast_varying(params, _vma_union(microbatches))
 
     def losses_of(prm):
-        if fused_stage_fn is not None:
-            return pipeline_encdec_fused(
-                lambda mb: enc_entry_fn(prm, mb),
-                lambda mb: dec_entry_fn(prm, mb),
-                lambda x, mem, stage: fused_stage_fn(prm, x, mem, stage),
-                lambda y, mb: last_fn(prm, y, mb),
-                microbatches, split_stage,
-                axis_name=axis_name, remat=remat,
-            )
         return pipeline_encdec(
             lambda mb: enc_entry_fn(prm, mb),
             lambda x: enc_stage_fn(prm, x),

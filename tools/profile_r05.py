@@ -1,0 +1,201 @@
+"""PROFILE_r05: single-process step-time decomposition on the real chip.
+
+VERDICT r4 item 2's artifact: which lever moved the MFU needle.  All
+variants run in ONE process (inter-process chip-state drift is +-4% on
+the axon tunnel; A/B only within a process), timing by host readback
+closing a chain of steps (block_until_ready returns early on this
+backend).  Writes PROFILE_r05.md + PROFILE_r05.json at the repo root.
+
+Run (chip required):  python tools/profile_r05.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# flagship bench config (bench.py child_gpt TPU path)
+VOCAB, LAYERS, HIDDEN, HEADS, SEQ, BATCH = 32768, 12, 1024, 8, 1024, 8
+WARMUP, STEPS = 2, 10
+
+
+def _require_tpu():
+    plat = jax.devices()[0].platform
+    if plat not in ("tpu", "axon"):
+        raise SystemExit(f"profile must run on TPU (got {plat})")
+
+
+def build(**cfg_over):
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.tensor_parallel.layers import state_specs_like
+
+    if parallel_state.model_parallel_is_initialized():
+        parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel()
+    cfg_kw = dict(
+        vocab_size=VOCAB, num_layers=LAYERS, hidden_size=HIDDEN,
+        num_attention_heads=HEADS, max_position_embeddings=SEQ,
+        compute_dtype=jnp.bfloat16, remat=True,
+    )
+    cfg_kw.update(cfg_over)
+    opt_only = cfg_kw.pop("_opt_only", False)
+    fwd_only = cfg_kw.pop("_fwd_only", False)
+    no_opt = cfg_kw.pop("_no_opt", False)
+    model = GPTModel(GPTConfig(**cfg_kw))
+    params = model.init(jax.random.PRNGKey(0))
+    specs = model.param_specs()
+    opt = FusedAdam(lr=1e-4, master_weights=True)
+    opt_state = opt.init(params)
+    opt_specs = state_specs_like(specs, opt_state)
+
+    def train_step(params, opt_state, tokens, targets):
+        if fwd_only:
+            loss = model.loss(params, tokens, targets)
+            return params, opt_state, loss
+        loss, grads = jax.value_and_grad(model.loss)(
+            params, tokens, targets)
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+        if opt_only:
+            # optimizer tail in isolation: grads replaced by params*0
+            # so the bwd graph is DCE'd but the opt update is intact.
+            # p*0 keeps the REAL grad dtype (grads match the bf16
+            # params), so the isolated tail reads the same bytes/elem
+            # as the full step's optimizer
+            grads = jax.tree.map(lambda p: p * 0, params)
+        if no_opt:
+            # fwd+bwd without the optimizer: fold grads into the loss
+            gsum = sum(jnp.sum(g.astype(jnp.float32) * 0)
+                       for g in jax.tree.leaves(grads))
+            return params, opt_state, loss + gsum
+        new_params, new_opt = opt.step(opt_state, grads, params)
+        return new_params, new_opt, loss
+
+    step = jax.jit(
+        jax.shard_map(
+            train_step, mesh=mesh,
+            in_specs=(specs, opt_specs, P("dp"), P("dp")),
+            out_specs=(specs, opt_specs, P()),
+        ),
+        donate_argnums=(0, 1),
+    )
+    place = lambda tree, sp: jax.device_put(
+        tree, jax.tree.map(lambda s: NamedSharding(mesh, s), sp,
+                           is_leaf=lambda x: isinstance(x, P)))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+    return (place(params, specs), place(opt_state, opt_specs), step,
+            n_params)
+
+
+def measure(label, **cfg_over):
+    params, opt_state, step, n_params = build(**cfg_over)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (BATCH, SEQ), 0, VOCAB)
+    targets = jnp.roll(tokens, -1, axis=1)
+    for _ in range(WARMUP):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    float(loss)  # host readback closes the warmup chain
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    final = float(loss)
+    dt = (time.perf_counter() - t0) / STEPS
+    assert jnp.isfinite(final), f"{label}: non-finite loss"
+    print(f"{label:28s} {dt * 1e3:8.2f} ms/step", flush=True)
+    return {"label": label, "ms_per_step": round(dt * 1e3, 2),
+            "n_params": n_params}
+
+
+def main():
+    _require_tpu()
+    # headline must succeed (everything is relative to it); each variant
+    # is individually fallible — an OOM (remat off is expected to flirt
+    # with it) or a transient tunnel error must not cost the already-
+    # captured rows of a scarce chip session
+    rows = [measure("headline (bf16+remat+fCE)")]
+    n_params = rows[0]["n_params"]
+    for label, kw in (
+        ("fused_ce off", {"fused_ce": False}),
+        ("remat off", {"remat": False}),
+        ("remat dots_saveable", {"remat_policy": "dots_saveable"}),
+        ("fwd only", {"_fwd_only": True}),
+        ("fwd+bwd, no optimizer", {"_no_opt": True}),
+        ("optimizer tail only", {"_opt_only": True}),
+    ):
+        try:
+            rows.append(measure(label, **kw))
+        except AssertionError:
+            raise  # non-finite loss is a correctness failure
+        except Exception as e:
+            print(f"{label}: FAILED ({str(e)[:160]})", flush=True)
+            rows.append({"label": label, "ms_per_step": None,
+                         "error": str(e)[:300]})
+
+    head_ms = rows[0]["ms_per_step"]
+    flops_per_token = 6 * n_params + 12 * LAYERS * HIDDEN * SEQ
+    tok_s = BATCH * SEQ / (head_ms / 1e3)
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    from bench import _peak_flops  # one bf16-peak table for all tools
+
+    peak = _peak_flops(jax.devices()[0])
+    mfu = tok_s * flops_per_token / peak if peak else None
+
+    doc = {
+        "config": {"vocab": VOCAB, "layers": LAYERS, "hidden": HIDDEN,
+                   "heads": HEADS, "seq": SEQ, "batch": BATCH,
+                   "device_kind": kind},
+        "rows": rows,
+        "tokens_per_sec": round(tok_s, 1),
+        "mfu": round(mfu, 4) if mfu else None,
+    }
+    with open(os.path.join(REPO, "PROFILE_r05.json"), "w") as f:
+        json.dump(doc, f, indent=1)
+
+    lines = [
+        "# PROFILE_r05 — step-time decomposition (flagship GPT, 1 chip)",
+        "",
+        f"Config: {LAYERS}L / h{HIDDEN} / b{BATCH} / s{SEQ} / "
+        f"vocab {VOCAB}, bf16 + fp32 masters, device `{kind}`.",
+        f"Headline: **{head_ms:.2f} ms/step, {tok_s:,.0f} tokens/s"
+        + (f", MFU {mfu:.4f}**" if mfu else "**"),
+        "",
+        "| variant | ms/step | delta vs headline |",
+        "|---|---|---|",
+    ]
+    for r in rows:
+        if r["ms_per_step"] is None:
+            lines.append(f"| {r['label']} | failed | — |")
+            continue
+        d = r["ms_per_step"] - head_ms
+        lines.append(f"| {r['label']} | {r['ms_per_step']:.2f} | "
+                     f"{d:+.2f} |")
+    lines += [
+        "",
+        "Reading: `fused_ce off` minus headline is the fused-CE win; "
+        "`remat off` minus headline is the remat recompute tax (negative "
+        "= remat is costing time at this memory headroom); headline "
+        "minus `fwd+bwd, no optimizer` is the optimizer tail; "
+        "`optimizer tail only` cross-checks it (fwd+opt with the bwd "
+        "DCE'd). All variants one process, host-readback timing "
+        "(axon tunnel rules).",
+    ]
+    with open(os.path.join(REPO, "PROFILE_r05.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(json.dumps({"mfu": doc["mfu"],
+                      "tokens_per_sec": doc["tokens_per_sec"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -145,6 +145,13 @@ def main():
                         2400, grace=90)
                     log(f"kernel sweep rc={rc2}")
                     sys.stderr.write((err2 or "")[-2000:])
+                    log("running PROFILE_r05 decomposition")
+                    rc3, out3, err3 = run(
+                        [PY, os.path.join(REPO, "tools",
+                                          "profile_r05.py")],
+                        2400, grace=90)
+                    log(f"profile rc={rc3}")
+                    sys.stderr.write((err3 or "")[-2000:])
                     return 0
                 log(f"bench ran but no TPU result (rc={rc}); continuing")
             else:
