@@ -47,6 +47,8 @@ __all__ = [
     "pipeline_1f1b",
     "pipeline_1f1b_interleaved",
     "pipeline_encdec",
+    "pipeline_encdec_fused",
+    "pipeline_encdec_fused_1f1b",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
@@ -1258,8 +1260,12 @@ def _fwd_bwd_encdec(
     the fused one-body-per-tick family — :func:`pipeline_encdec_fused_
     1f1b`, true 1F1B memory (O(pp) saved stage-input pairs instead of
     the vjp-through-GPipe tape); ``enc_stage_fn``/``dec_stage_fn`` are
-    then ignored (pass ``None``).  The two-stream fallback below keeps
-    GPipe-memory vjp semantics."""
+    then ignored (pass ``None``), and so is ``remat`` — the 1F1B
+    schedule ALWAYS recomputes stage activations from its saved stage
+    inputs (per-stage remat is the schedule's memory contract, not an
+    option; any ``jax.checkpoint`` INSIDE the model's stage body still
+    applies).  The two-stream fallback below keeps GPipe-memory vjp
+    semantics."""
     if fused_stage_fn is not None:
         return pipeline_encdec_fused_1f1b(
             enc_entry_fn, dec_entry_fn, fused_stage_fn, last_fn,

@@ -30,6 +30,12 @@ import subprocess
 import sys
 import time
 
+# Flagship GPT measurement config (the TPU path of child_gpt);
+# tools/profile_r05.py decomposes the SAME program — one definition so
+# the decomposition's headline cannot drift from the bench headline
+FLAGSHIP = dict(vocab_size=32768, num_layers=12, hidden_size=1024,
+                num_attention_heads=8, seq=1024, batch=8)
+
 PROBE_TIMEOUT = int(os.environ.get("APEX_BENCH_PROBE_TIMEOUT", "120"))
 CHILD_TIMEOUT = int(os.environ.get("APEX_BENCH_CHILD_TIMEOUT", "1200"))
 TOTAL_BUDGET = int(os.environ.get("APEX_BENCH_TOTAL_BUDGET", "3000"))
@@ -153,16 +159,17 @@ def child_gpt(platform: str):
     # CPU fallback uses a small config so the bench finishes on a 1-core
     # host; the TPU config is the real measurement
     cfg_common = dict(
-        vocab_size=32768 if on_tpu else 4096,
-        num_layers=12 if on_tpu else 2,
-        hidden_size=1024 if on_tpu else 256,
-        num_attention_heads=8 if on_tpu else 4,
+        vocab_size=FLAGSHIP["vocab_size"] if on_tpu else 4096,
+        num_layers=FLAGSHIP["num_layers"] if on_tpu else 2,
+        hidden_size=FLAGSHIP["hidden_size"] if on_tpu else 256,
+        num_attention_heads=(FLAGSHIP["num_attention_heads"]
+                             if on_tpu else 4),
     )
-    BATCH = 8 if on_tpu else 2
+    BATCH = FLAGSHIP["batch"] if on_tpu else 2
     # MFU is batch-sensitive: the fast path sweeps these and keeps the
     # best (HBM permitting), the baseline uses BATCH for comparability
     FAST_BATCHES = (8, 16, 32) if on_tpu else (2,)
-    SEQ = 1024 if on_tpu else 256
+    SEQ = FLAGSHIP["seq"] if on_tpu else 256
     WARMUP = 2
     STEPS = 10 if on_tpu else 4
 

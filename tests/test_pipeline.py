@@ -692,3 +692,96 @@ def test_get_forward_backward_func_encdec_dispatch():
             )
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def test_encdec_fused_1f1b_grads_match_gpipe_pp4():
+    """Enc-dec 1F1B at pp=4 / split=2: TWO decoder stages, so the mem
+    cotangent genuinely accumulates across stages before the split
+    crossover — vs jax.grad through the fused GPipe schedule (the pp=2
+    T5 test has one decoder stage and cannot catch a broken dmem sum)."""
+    from apex_tpu.transformer.pipeline_parallel import (
+        pipeline_encdec_fused,
+        pipeline_encdec_fused_1f1b,
+        pipeline_stage_specs,
+        sync_replicated_grads,
+    )
+
+    PP, H, ROWS, M = 4, 16, 4, 4
+    split = 2
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=PP
+    )
+    try:
+        k = jax.random.PRNGKey(0)
+        params = {
+            "w": 0.3 * jax.random.normal(k, (PP, H, H)),
+            "cross": 0.3 * jax.random.normal(
+                jax.random.fold_in(k, 1), (PP, H, H)),
+            "head": 0.3 * jax.random.normal(
+                jax.random.fold_in(k, 2), (H, H)),
+        }
+        specs = {**pipeline_stage_specs(
+            {"w": P(None, None, None), "cross": P(None, None, None)}
+        ), "head": P()}
+        x = jax.random.normal(jax.random.fold_in(k, 3), (M, ROWS, H))
+        y = jax.random.normal(jax.random.fold_in(k, 4), (M, ROWS, H))
+        mbs = {"x": x, "y": y}
+
+        def stage_fn(prm, h, mem, stage_idx):
+            # self part + gated "cross-attention" consuming mem: every
+            # decoder stage contributes a mem cotangent
+            gate = (stage_idx >= split).astype(h.dtype)
+            h = jnp.tanh(h @ prm["w"][0])
+            return h + gate * jnp.tanh(mem @ prm["cross"][0])
+
+        def enc_entry(prm, mb):
+            return mb["x"]
+
+        def dec_entry(prm, mb):
+            return mb["x"] * 0.5
+
+        def last_fn(prm, h, mb):
+            return jnp.mean((h @ prm["head"] - mb["y"]) ** 2)
+
+        def fb_1f1b(params, mbs):
+            losses, grads = pipeline_encdec_fused_1f1b(
+                enc_entry, dec_entry, stage_fn, last_fn, params, mbs,
+                split,
+            )
+            return jnp.mean(losses), sync_replicated_grads(grads, specs)
+
+        def fb_gpipe(params, mbs):
+            def loss(prm):
+                per = pipeline_encdec_fused(
+                    lambda mb: enc_entry(prm, mb),
+                    lambda mb: dec_entry(prm, mb),
+                    lambda h, mem, s: stage_fn(prm, h, mem, s),
+                    lambda h, mb: last_fn(prm, h, mb),
+                    mbs, split, remat=False,
+                )
+                return jnp.mean(per)
+
+            l, grads = jax.value_and_grad(loss)(params)
+            return l, sync_replicated_grads(grads, specs)
+
+        run = lambda f: jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(specs, P()), out_specs=(P(), specs),
+        ))
+        placed = jax.device_put(params, jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P)))
+        l1, g1 = run(fb_1f1b)(placed, mbs)
+        l2, g2 = run(fb_gpipe)(placed, mbs)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        for key in ("w", "cross", "head"):
+            np.testing.assert_allclose(
+                np.asarray(g1[key]), np.asarray(g2[key]),
+                rtol=1e-5, atol=1e-6, err_msg=key,
+            )
+        # the cross grads on decoder stages must be nonzero (mem path
+        # live) and zero on encoder stages (gate off)
+        g_cross = np.asarray(g1["cross"])
+        assert np.abs(g_cross[split:]).max() > 1e-6
+        np.testing.assert_allclose(g_cross[:split], 0.0, atol=1e-7)
+    finally:
+        parallel_state.destroy_model_parallel()
