@@ -29,7 +29,8 @@ import numpy as np
 
 from apex_tpu import csrc
 
-__all__ = ["save", "restore", "latest_step", "save_step", "restore_step"]
+__all__ = ["save", "restore", "latest_step", "save_step", "restore_step",
+           "save_async", "wait_pending_saves"]
 
 _MANIFEST = "manifest.json"
 _DATA = "data.bin"
@@ -51,8 +52,19 @@ except Exception:  # pragma: no cover
 
 
 def save(path: str, tree: Any) -> None:
-    """Persist a pytree of arrays (and scalars) to ``path`` (a dir)."""
-    os.makedirs(path, exist_ok=True)
+    """Persist a pytree of arrays (and scalars) to ``path`` (a dir).
+
+    Atomic visibility: everything is written into ``path + ".tmp"`` and
+    renamed into place, so a reader (``latest_step`` filters the
+    ``.tmp`` suffix out; a crashed writer leaves only a ``.tmp`` husk)
+    can never observe a half-written checkpoint — essential now that
+    :func:`save_async` stretches the write over whole training steps."""
+    import pickle
+    import shutil
+
+    tmp = path.rstrip("/") + ".tmp"
+    shutil.rmtree(tmp, ignore_errors=True)  # stale husk from a crash
+    os.makedirs(tmp)
     flat, treedef = jax.tree_util.tree_flatten(jax.device_get(tree))
     arrays = [np.asarray(l) for l in flat]
     manifest = {
@@ -63,17 +75,17 @@ def save(path: str, tree: Any) -> None:
         ],
     }
     blob = csrc.flatten(arrays)
-    with open(os.path.join(path, _DATA), "wb") as f:
+    with open(os.path.join(tmp, _DATA), "wb") as f:
         f.write(blob.tobytes())
-    with open(os.path.join(path, _MANIFEST), "w") as f:
+    with open(os.path.join(tmp, _MANIFEST), "w") as f:
         json.dump(manifest, f)
     # the structure itself is pickled; this couples a checkpoint to the
     # jax treedef format, so restore with a `target` tree when loading
     # checkpoints across jax upgrades
-    import pickle
-
-    with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+    with open(os.path.join(tmp, "treedef.pkl"), "wb") as f:
         pickle.dump(treedef, f)
+    shutil.rmtree(path, ignore_errors=True)  # overwrite semantics
+    os.rename(tmp, path)
 
 
 def restore(path: str, target: Optional[Any] = None) -> Any:
@@ -111,6 +123,100 @@ def restore(path: str, target: Optional[Any] = None) -> Any:
              for t, r in zip(t_flat, r_flat)],
         )
     return tree
+
+
+class _PendingSave:
+    """Handle for an in-flight :func:`save_async`; ``result()`` blocks
+    until the write lands (re-raising any writer exception)."""
+
+    def __init__(self, thread, box):
+        self._thread = thread
+        self._box = box
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def result(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("checkpoint write still in flight")
+        if self._box["exc"] is not None:
+            raise self._box["exc"]
+
+
+_pending_saves: list = []
+
+
+def save_async(path: str, tree: Any) -> _PendingSave:
+    """:func:`save` with the expensive half off the training thread.
+
+    The device→host snapshot (``jax.device_get``) happens
+    SYNCHRONOUSLY before returning — under buffer donation the arrays'
+    storage is reused by the next step, so the copy cannot be deferred
+    — then the flatten + file writes run in a daemon thread (both
+    release the GIL: the C++ flatten and file I/O).  The training loop
+    resumes immediately; a step's save typically overlaps the next
+    steps' device execution entirely.
+
+    Returns a handle; call ``result()`` before depending on the files
+    (e.g. before process exit), or :func:`wait_pending_saves` to drain
+    everything.  Concurrent saves to the SAME path are the caller's
+    race to avoid (step-numbered dirs via :func:`save_step` never
+    collide)."""
+    import threading
+
+    host_tree = jax.device_get(tree)
+    box = {"exc": None}
+
+    def writer():
+        try:
+            save(path, host_tree)
+        except BaseException as e:  # surfaced via result()
+            box["exc"] = e
+
+    t = threading.Thread(target=writer, daemon=True,
+                         name=f"ckpt-save:{os.path.basename(path)}")
+    t.start()
+    handle = _PendingSave(t, box)
+    _pending_saves.append(handle)
+    if len(_pending_saves) > 64:
+        # prune cleanly-finished handles only: a completed-with-error
+        # handle must survive so wait_pending_saves still reports it
+        _pending_saves[:] = [
+            h for h in _pending_saves
+            if not h.done() or h._box["exc"] is not None
+        ]
+    return handle
+
+
+def wait_pending_saves(timeout: Optional[float] = None) -> None:
+    """Block until every :func:`save_async` issued so far has landed
+    (call before process exit / after the last step).
+
+    Joins ALL handles before raising — a failed early save must not
+    leave later in-flight writers to be killed mid-file by process
+    exit — then raises the first failure (others noted in its message).
+    ``timeout`` bounds the WHOLE drain, not each handle."""
+    import time as _time
+
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    errors = []
+    for h in list(_pending_saves):
+        left = (None if deadline is None
+                else max(0.0, deadline - _time.monotonic()))
+        try:
+            h.result(left)
+        except Exception as e:
+            errors.append(e)
+    _pending_saves.clear()
+    if errors:
+        if len(errors) > 1:
+            raise RuntimeError(
+                f"{len(errors)} checkpoint saves failed; first: "
+                f"{errors[0]!r}; also: "
+                + "; ".join(repr(e) for e in errors[1:3])
+            ) from errors[0]
+        raise errors[0]
 
 
 def save_step(root: str, step: int, tree: Any) -> str:

@@ -119,3 +119,67 @@ class TestCheckpoint:
             np.asarray(restored["opt"]["master"]["w"]),
             np.asarray(opt_state["master"]["w"]),
         )
+
+
+class TestAsyncSave:
+    def test_async_roundtrip_bitwise(self, tmp_path):
+        tree = {
+            "w": jnp.arange(1024, dtype=jnp.float32).reshape(32, 32),
+            "m": jnp.ones((7,), jnp.bfloat16) * 0.5,
+            "step": jnp.int32(42),
+        }
+        h = ckpt.save_async(str(tmp_path / "a"), tree)
+        h.result(timeout=30)
+        back = ckpt.restore(str(tmp_path / "a"))
+        for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_snapshot_is_taken_before_return(self, tmp_path):
+        """The device->host copy happens synchronously: mutating (or
+        deleting) the source after save_async returns must not change
+        what lands on disk — the donation-safety contract."""
+        x = jnp.zeros((64, 64), jnp.float32) + 3.0
+        h = ckpt.save_async(str(tmp_path / "s"), {"x": x})
+        x = x * 0 - 1.0  # new value; old buffer may be reused
+        del x
+        h.result(timeout=30)
+        back = ckpt.restore(str(tmp_path / "s"))
+        np.testing.assert_array_equal(np.asarray(back["x"]),
+                                      np.full((64, 64), 3.0, np.float32))
+
+    def test_concurrent_step_saves_and_drain(self, tmp_path):
+        for step in range(4):
+            ckpt.save_async(str(tmp_path / f"step_{step}"),
+                            {"v": jnp.full((8,), step, jnp.float32)})
+        ckpt.wait_pending_saves(timeout=60)
+        assert ckpt.latest_step(str(tmp_path)) == 3
+        for step in range(4):
+            back = ckpt.restore_step(str(tmp_path), step=step)
+            np.testing.assert_array_equal(
+                np.asarray(back["v"]), np.full((8,), step, np.float32))
+
+    def test_writer_exception_surfaces(self, tmp_path):
+        target = tmp_path / "blocked"
+        target.write_text("a file where a directory must go")
+        h = ckpt.save_async(str(target), {"x": jnp.ones((2,))})
+        with pytest.raises(Exception):
+            h.result(timeout=30)
+
+    def test_tmp_dirs_invisible_to_latest_step(self, tmp_path):
+        """Atomicity: a crashed writer's .tmp husk is never selected."""
+        ckpt.save_step(str(tmp_path), 4, {"v": jnp.ones((2,))})
+        (tmp_path / "step_5.tmp").mkdir()  # simulated mid-write crash
+        assert ckpt.latest_step(str(tmp_path)) == 4
+        back = ckpt.restore_step(str(tmp_path))
+        assert back is not None
+
+    def test_drain_reports_failure_and_joins_all(self, tmp_path):
+        blocked = tmp_path / "blocked"
+        blocked.write_text("file blocks dir rename")
+        ckpt.save_async(str(blocked), {"x": jnp.ones((2,))})
+        ckpt.save_async(str(tmp_path / "fine"), {"x": jnp.ones((2,))})
+        with pytest.raises(Exception):
+            ckpt.wait_pending_saves(timeout=30)
+        # the healthy sibling still landed before the raise
+        back = ckpt.restore(str(tmp_path / "fine"))
+        np.testing.assert_array_equal(np.asarray(back["x"]), [1.0, 1.0])
