@@ -58,7 +58,12 @@ def save(path: str, tree: Any) -> None:
     renamed into place, so a reader (``latest_step`` filters the
     ``.tmp`` suffix out; a crashed writer leaves only a ``.tmp`` husk)
     can never observe a half-written checkpoint — essential now that
-    :func:`save_async` stretches the write over whole training steps."""
+    :func:`save_async` stretches the write over whole training steps.
+    Scope: the guarantee is fresh-or-complete.  OVERWRITING an existing
+    path removes the old copy before the rename lands, so a concurrent
+    reader of that exact path can briefly see it absent — use
+    step-numbered dirs (:func:`save_step`), which never overwrite, when
+    another process reads checkpoints live."""
     import pickle
     import shutil
 
@@ -165,14 +170,20 @@ def save_async(path: str, tree: Any) -> _PendingSave:
     collide)."""
     import threading
 
-    host_tree = jax.device_get(tree)
+    # the snapshot travels in a clearable cell: the writer drops it in
+    # `finally`, so neither a kept (failed) handle nor an exception
+    # traceback can pin a checkpoint-sized host tree in memory
+    payload = [jax.device_get(tree)]
     box = {"exc": None}
 
     def writer():
         try:
-            save(path, host_tree)
+            save(path, payload[0])
         except BaseException as e:  # surfaced via result()
+            e.__traceback__ = None  # frames reference the snapshot
             box["exc"] = e
+        finally:
+            payload.clear()
 
     t = threading.Thread(target=writer, daemon=True,
                          name=f"ckpt-save:{os.path.basename(path)}")
@@ -196,19 +207,28 @@ def wait_pending_saves(timeout: Optional[float] = None) -> None:
     Joins ALL handles before raising — a failed early save must not
     leave later in-flight writers to be killed mid-file by process
     exit — then raises the first failure (others noted in its message).
-    ``timeout`` bounds the WHOLE drain, not each handle."""
+    ``timeout`` bounds the WHOLE drain, not each handle.  Handles that
+    did not finish within the timeout STAY tracked, so a later
+    ``wait_pending_saves()`` retry genuinely waits for them instead of
+    returning instantly on an emptied list."""
     import time as _time
 
     deadline = None if timeout is None else _time.monotonic() + timeout
     errors = []
+    drained = []
     for h in list(_pending_saves):
         left = (None if deadline is None
                 else max(0.0, deadline - _time.monotonic()))
         try:
             h.result(left)
+            drained.append(h)
+        except TimeoutError as e:
+            errors.append(e)  # still in flight: keep tracking it
         except Exception as e:
             errors.append(e)
-    _pending_saves.clear()
+            drained.append(h)  # finished (badly): done tracking
+    for h in drained:
+        _pending_saves.remove(h)
     if errors:
         if len(errors) > 1:
             raise RuntimeError(

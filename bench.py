@@ -167,8 +167,9 @@ def child_gpt(platform: str):
     )
     BATCH = FLAGSHIP["batch"] if on_tpu else 2
     # MFU is batch-sensitive: the fast path sweeps these and keeps the
-    # best (HBM permitting), the baseline uses BATCH for comparability
-    FAST_BATCHES = (8, 16, 32) if on_tpu else (2,)
+    # best (HBM permitting — the sweep ends quietly at the first OOM),
+    # the baseline uses BATCH for comparability
+    FAST_BATCHES = (8, 16, 32, 64) if on_tpu else (2,)
     SEQ = FLAGSHIP["seq"] if on_tpu else 256
     WARMUP = 2
     STEPS = 10 if on_tpu else 4
@@ -755,9 +756,58 @@ def _run_child(args, timeout):
     return False, None, "no JSON in child output"
 
 
+def _clear_tpu_watcher():
+    """Gate-time right-of-way: if tools/tpu_watch.py is mid-probe, its
+    queued claim would contend with this bench's.  SIGTERM it — its
+    handler tears down the probe child FIRST (tools/tpu_watch.py
+    _sigterm), releasing the lane cleanly — and wait for the lock to
+    drop before probing ourselves."""
+    lock = "/tmp/apex_tpu_watch.lock"
+    try:
+        pid = int(open(lock).read().strip())
+    except (OSError, ValueError):
+        return
+    if pid == os.getppid():
+        # this bench IS the watcher's capture child: killing the parent
+        # would terminate ourselves (its child-first teardown targets
+        # exactly this process) — the lane is already ours
+        return
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read().decode("utf-8", "replace")
+    except OSError:
+        cmdline = ""
+    if "tpu_watch" not in cmdline:
+        # stale lock whose pid was recycled by an unrelated process:
+        # never signal it, just clear the husk
+        try:
+            os.remove(lock)
+        except OSError:
+            pass
+        return
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        try:
+            os.remove(lock)
+        except OSError:
+            pass
+        return
+    log(f"waiting for tpu_watch (pid {pid}) to release the chip lane")
+    # the watcher's teardown waits up to ~300s for a claim-holding
+    # probe to exit cleanly; give it that long plus slack
+    for _ in range(420):
+        if not os.path.exists(lock):
+            log("tpu_watch released")
+            return
+        time.sleep(1)
+    log("tpu_watch did not release within 420s; proceeding anyway")
+
+
 def main():
     t_start = time.perf_counter()
     errors = []
+    _clear_tpu_watcher()
 
     def budget_left():
         return TOTAL_BUDGET - (time.perf_counter() - t_start)

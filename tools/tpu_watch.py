@@ -37,11 +37,51 @@ def log(*a):
     print(f"[tpu_watch {time.strftime('%H:%M:%S')}]", *a, flush=True)
 
 
+_current_proc = None
+
+
+def _sigterm(signum, frame):
+    """Child-FIRST teardown: killing this watcher while its probe child
+    is queued for the chip claim would orphan the child; an orphan that
+    later wins the grant dies on SIGPIPE (dead parent pipe) while
+    HOLDING the claim — the exact wedge this daemon exists to outlive.
+    So on SIGTERM (e.g. the gate-time bench clearing the lane): SIGTERM
+    the child, wait, only then exit."""
+    import sys as _sys
+
+    p = _current_proc
+    if p is not None and p.poll() is None:
+        log("SIGTERM: terminating child first")
+        p.terminate()
+        try:
+            # same 300s grace as the probe window's: a claim-holding
+            # child needs time for clean client teardown, and a hard
+            # kill here re-creates the 1.5h wedge this daemon exists
+            # to outlive
+            p.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            log("child ignored SIGTERM for 300s; SIGKILL "
+                "(claim may wedge)")
+            p.kill()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+    try:
+        os.remove(LOCK)
+    except OSError:
+        pass
+    log("exiting on SIGTERM")
+    _sys.exit(143)
+
+
 def run(args, timeout, grace=60):
     """SIGTERM-first bounded subprocess (never immediate SIGKILL: a hard
     kill of a client holding the chip claim is what wedges the pool)."""
+    global _current_proc
     proc = subprocess.Popen(args, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True, cwd=REPO)
+    _current_proc = proc
     try:
         out, err = proc.communicate(timeout=timeout)
         return proc.returncode, out, err
@@ -54,17 +94,23 @@ def run(args, timeout, grace=60):
             proc.kill()
             out, err = proc.communicate()
         return -1, out, err
+    finally:
+        _current_proc = None
 
 
-def probe(timeout=1500):
+def probe(timeout=3600):
     """Long-window probe: the axon pool queues claim requests, so a
     claimant that WAITS converts the wedge's expiry into an immediate
     grant — far better than short probes that must be SIGKILLed (a kill
     racing a just-arrived grant is exactly what re-wedges the pool).
-    The child exits cleanly on grant, releasing the claim for the bench
-    run that follows."""
+    The window is deliberately LONG and the grace generous: the doom
+    scenario is a grant arriving seconds before the timeout and the
+    claim-holding child dying to SIGKILL — each boundary is a re-wedge
+    lottery, so have as few boundaries as possible.  The child exits
+    cleanly on grant, releasing the claim for the bench run that
+    follows."""
     rc, out, err = run([PY, os.path.join(REPO, "bench.py"),
-                        "--child", "probe"], timeout, grace=120)
+                        "--child", "probe"], timeout, grace=300)
     if rc != 0:
         return None
     for line in reversed((out or "").strip().splitlines()):
@@ -77,6 +123,9 @@ def probe(timeout=1500):
 
 
 def main():
+    import signal
+
+    signal.signal(signal.SIGTERM, _sigterm)
     interval = 420
     deadline_s = 9 * 3600
     for i, a in enumerate(sys.argv):
