@@ -94,6 +94,15 @@ class TestResNet:
          ["--depth", "18", "--batch-size", "1", "--image-size", "32",
           "--epochs", "1", "--steps-per-epoch", "2", "--eval-steps", "1",
           "--num-classes", "10"]),
+        ("examples/gpt_pretrain.py",
+         ["--tp", "2", "--pp", "2", "--num-micro", "2", "--vocab", "64",
+          "--layers", "2", "--hidden", "32", "--heads", "4",
+          "--seq", "16", "--micro-batch", "1", "--steps", "3"]),
+        ("examples/gpt_pretrain.py",
+         ["--pp", "2", "--num-micro", "2", "--vocab", "64",
+          "--layers", "2", "--hidden", "32", "--heads", "4",
+          "--seq", "16", "--micro-batch", "1", "--steps", "3",
+          "--zero", "--opt-level", "O2"]),
     ],
 )
 def test_example_runs(script, args):
