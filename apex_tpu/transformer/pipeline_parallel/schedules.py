@@ -1322,7 +1322,12 @@ def get_forward_backward_func(
       enc-dec schedule pre-bound to the installed
       ``pipeline_model_parallel_split_rank``; its signature is
       ``fn(enc_entry_fn, enc_stage_fn, dec_entry_fn, dec_stage_fn,
-      last_fn, params, microbatches, **kw)``.
+      last_fn, params, microbatches, **kw)``.  Pass
+      ``fused_stage_fn=...`` to run the fused one-body-per-tick
+      schedule with true 1F1B memory
+      (:func:`pipeline_encdec_fused_1f1b` — what
+      ``T5Model(fused_pipeline=True)`` does); without it the
+      two-stream GPipe-vjp fallback runs.
 
     Apply ``sync_replicated_grads`` to the returned grads for shared
     (pp-replicated) params, as with :func:`pipeline_1f1b`.  The GPipe
