@@ -260,3 +260,74 @@ def test_golden_baseline_traces(mesh):
                     "docstring), unintentional ones are a regression"
                 ),
             )
+
+
+def test_golden_pipeline_trace(mesh):
+    """Schedule-numerics golden: a fixed pp=2 GPT's 10-step loss trace
+    through pipeline_1f1b_grads must match the committed baseline —
+    catches silent drift in the compiled schedule itself (the
+    cross-product cells above only cover the sequential path)."""
+    import json
+
+    path = os.path.join(os.path.dirname(GOLDEN_PATH),
+                        "pipeline_1f1b_trace.json")
+    # needs its own pp mesh: tear down the module fixture's, and
+    # restore it in the finally so later/reordered tests in this
+    # module still see initialized parallel state
+    parallel_state.destroy_model_parallel()
+    m2 = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=2)
+    try:
+        cfg = GPTConfig(
+            vocab_size=VOCAB, num_layers=LAYERS, hidden_size=HIDDEN,
+            num_attention_heads=HEADS, max_position_embeddings=SEQ,
+            compute_dtype=jnp.float32, remat=False, attention_impl="xla",
+        )
+        model = GPTModel(cfg)
+        specs = model.pipeline_param_specs()
+        params = model.init(jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-2)
+        opt_state = opt.init(params)
+        from apex_tpu.transformer.tensor_parallel.layers import (
+            state_specs_like,
+        )
+
+        opt_specs = state_specs_like(specs, opt_state)
+        tokens, targets = _data()
+
+        def stepf(p, s, t, y):
+            loss, grads = model.pipeline_1f1b_grads(p, t, y, 2)
+            p, s = opt.step(s, grads, p)
+            return p, s, loss
+
+        jstep = jax.jit(jax.shard_map(
+            stepf, mesh=m2,
+            in_specs=(specs, opt_specs, P("dp"), P("dp")),
+            out_specs=(specs, opt_specs, P()),
+        ))
+        place = lambda t, sp: jax.device_put(
+            t, jax.tree.map(lambda s: NamedSharding(m2, s), sp,
+                            is_leaf=lambda x: isinstance(x, P)))
+        p, s = place(params, specs), place(opt_state, opt_specs)
+        trace = []
+        for _ in range(10):
+            p, s, loss = jstep(p, s, tokens, targets)
+            trace.append(float(loss))
+
+        if os.environ.get("APEX_TPU_REGEN_GOLDEN"):
+            with open(path, "w") as f:
+                json.dump({"loss": trace}, f, indent=1)
+            pytest.skip(f"regenerated {path}; commit it")
+        assert os.path.exists(path), (
+            f"golden file missing: {path} — regenerate with "
+            "APEX_TPU_REGEN_GOLDEN=1")
+        with open(path) as f:
+            golden = json.load(f)
+        np.testing.assert_allclose(
+            trace, golden["loss"], rtol=1e-5, atol=1e-7,
+            err_msg="pipeline_1f1b numeric drift (see module docstring)",
+        )
+    finally:
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=2)
