@@ -133,7 +133,8 @@ class _DistributedOptimizer:
     """
 
     def __init__(self, lr: float, axis_name: Any = DATA_PARALLEL_AXIS,
-                 compressed_allgather: Optional[str] = None):
+                 compressed_allgather: Optional[str] = None,
+                 param_specs: Any = None):
         if compressed_allgather not in (None, "bf16", "e5m2"):
             raise ValueError(
                 "compressed_allgather must be None, 'bf16' or 'e5m2'"
@@ -144,6 +145,71 @@ class _DistributedOptimizer:
         # (reference: distributed_fused_adam.py e5m2 compressed allgather):
         # masters stay fp32; only the gathered bytes shrink 2x/4x
         self.compressed_allgather = compressed_allgather
+        # param_specs enables DATA-AXIS-SHARDED leaves (MoE expert
+        # weights riding "dp" as the ep axis): those leaves must NOT go
+        # through the flat reduce-scatter/all-gather (each rank owns
+        # its experts outright — an RS over dp would sum unrelated
+        # shards); they get a rank-LOCAL fp32-master update instead,
+        # selected by whether the leaf's spec names the shard axis
+        self.param_specs = param_specs
+        if param_specs is not None:
+            mask = self._local_mask()
+            if self._has_local(mask):
+                # fail FAST, not at step-trace time
+                if self._hierarchical:
+                    raise NotImplementedError(
+                        "data-axis-sharded leaves are not supported with "
+                        "a hierarchical axis_name: the rank-local path "
+                        "performs no collectives, so the cross-axis "
+                        "(dcn) replicas would silently diverge"
+                    )
+                if (type(self)._local_update
+                        is _DistributedOptimizer._local_update):
+                    raise NotImplementedError(
+                        f"{type(self).__name__} does not support "
+                        "data-axis-sharded params (its update couples "
+                        "leaves globally, e.g. the LAMB grad-norm "
+                        "clip); use DistributedFusedAdam for MoE "
+                        "expert-parallel models or drop param_specs"
+                    )
+
+    # ---------------------------------------------------- local leaves
+    def _local_mask(self):
+        """Pytree of bools over param_specs: True = leaf storage is
+        sharded over the data axis → rank-local update path."""
+        from apex_tpu.transformer.parallel_state import spec_axis_names
+
+        axes = {self._shard_axis}
+        if self._cross_axis is not None:
+            axes.add(self._cross_axis)
+        return jax.tree.map(
+            lambda s: bool(axes & set(spec_axis_names(s))),
+            self.param_specs, is_leaf=lambda x: isinstance(x, P),
+        )
+
+    @staticmethod
+    def _mask_tree(tree: Any, mask: Any, keep_local: bool) -> Any:
+        """Replace the unwanted half's leaves with 0-size placeholders
+        (structure stays identical, flatten skips zero elements)."""
+        def f(m, x):
+            if m == keep_local:
+                return x
+            return jnp.zeros((0,), jnp.asarray(x).dtype)
+
+        return jax.tree.map(f, mask, tree)
+
+    def _has_local(self, mask) -> bool:
+        return any(jax.tree.leaves(mask))
+
+    def _local_update(self, extra: dict, step, g, p, lr):
+        """Per-leaf update rule for data-axis-sharded leaves; only
+        optimizers without cross-leaf coupling can support it."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support data-axis-sharded "
+            "params (its update couples leaves globally, e.g. the LAMB "
+            "grad-norm clip); use DistributedFusedAdam for MoE "
+            "expert-parallel models or drop param_specs"
+        )
 
     @property
     def _hierarchical(self) -> bool:
@@ -185,12 +251,33 @@ class _DistributedOptimizer:
         specs = {k: P(ax) for k in self._extra_init(1)}
         specs["step"] = P()
         specs["master"] = P(ax)
+        if self.param_specs is not None:
+            mask = self._local_mask()
+            if self._has_local(mask):
+                # data-axis-sharded leaves keep the PARAM's own spec
+                # (their state lives where the shard lives); the
+                # replicated half's placeholders are 0-size → P()
+                lspec = jax.tree.map(
+                    lambda m, s: s if m else P(),
+                    mask, self.param_specs,
+                )
+                specs["local"] = {"master": lspec,
+                                  **{k: lspec
+                                     for k in self._extra_init(1)}}
         return specs
 
     def init(self, params: Any) -> dict:
         """Build the sharded state — call inside shard_map with
         replicated params; each rank keeps only its flat shard
-        (1/ici per device, replicated across dcn, when hierarchical)."""
+        (1/ici per device, replicated across dcn, when hierarchical).
+        With ``param_specs`` given, data-axis-sharded leaves get a
+        rank-local fp32 master + moments instead (see __init__)."""
+        local_tree = None
+        if self.param_specs is not None:
+            mask = self._local_mask()
+            if self._has_local(mask):
+                local_tree = self._mask_tree(params, mask, True)
+                params = self._mask_tree(params, mask, False)
         world = lax.axis_size(self._shard_axis)
         rank = lax.axis_index(self._shard_axis)
         meta = _FlatMeta(params, world)
@@ -198,6 +285,14 @@ class _DistributedOptimizer:
         local = lax.dynamic_slice(flat, (rank * meta.shard,), (meta.shard,))
         state = {"step": jnp.int32(0), "master": local}
         state.update(self._extra_init(meta.shard))
+        if local_tree is not None:
+            f32_tree = jax.tree.map(
+                lambda x: jnp.asarray(x, jnp.float32), local_tree)
+            state["local"] = {
+                "master": f32_tree,
+                **{k: jax.tree.map(jnp.zeros_like, f32_tree)
+                   for k in self._extra_init(1)},
+            }
         return state
 
     def step(
@@ -207,6 +302,7 @@ class _DistributedOptimizer:
         params: Any,
         lr: Optional[jnp.ndarray] = None,
         grads_finite: Optional[jnp.ndarray] = None,
+        local_grads_prenormalized: bool = False,
     ) -> Tuple[Any, dict]:
         """reduce-scatter grads → sharded update → all-gather params.
 
@@ -214,7 +310,24 @@ class _DistributedOptimizer:
         over dp; the reduce-scatter here replaces that all-reduce
         (reference: distributed_fused_adam.py overlapped RS+AR).
         Returns (new_params in model dtype, new_state).
+
+        Data-axis-sharded leaves (``param_specs``): in the raw
+        convention their grads are the backward all_to_all's SUM of
+        every rank's contribution, so the local path divides by world
+        to match the flat path's mean semantics.  If you hand grads
+        that are ALREADY optimizer-ready for those leaves (e.g. the
+        models' pipeline ``data_reduce`` convention, which applies the
+        1/n itself), pass ``local_grads_prenormalized=True`` to skip
+        the division.
         """
+        local_params = local_grads = None
+        if self.param_specs is not None:
+            mask = self._local_mask()
+            if self._has_local(mask):
+                local_params = self._mask_tree(params, mask, True)
+                local_grads = self._mask_tree(grads, mask, True)
+                params = self._mask_tree(params, mask, False)
+                grads = self._mask_tree(grads, mask, False)
         world = lax.axis_size(self._shard_axis)
         rank = lax.axis_index(self._shard_axis)
         meta = _FlatMeta(params, world)
@@ -248,6 +361,21 @@ class _DistributedOptimizer:
         new_state = dict(new_extra)
         new_state["step"] = new_step
         new_state["master"] = new_master
+        if local_params is not None:
+            # rank-local update of the data-axis-sharded leaves: no
+            # collectives — their grads are already complete on the
+            # owning rank (the MoE backward all_to_all accumulated
+            # every token's contribution into the expert's owner)
+            lextra = {k: v for k, v in state["local"].items()
+                      if k != "master"}
+            lscale = (1.0 if local_grads_prenormalized
+                      else 1.0 / lax.axis_size(self._shard_axis))
+            lgrads = jax.tree.map(
+                lambda g: jnp.asarray(g, jnp.float32) * lscale,
+                local_grads)
+            new_lmaster, new_lextra = self._local_update(
+                lextra, new_step, lgrads, state["local"]["master"], lr)
+            new_state["local"] = {"master": new_lmaster, **new_lextra}
         if grads_finite is not None:
             new_state = tree_where(grads_finite, new_state, state)
             new_master = new_state["master"]
@@ -263,6 +391,15 @@ class _DistributedOptimizer:
             send, self._shard_axis, axis=0, tiled=True
         )
         new_params = meta.unflatten(flat_params)
+        if local_params is not None:
+            local_out = jax.tree.map(
+                lambda m, p: m.astype(jnp.asarray(p).dtype),
+                new_state["local"]["master"], local_params,
+            )
+            new_params = jax.tree.map(
+                lambda is_local, a, b: b if is_local else a,
+                mask, new_params, local_out,
+            )
         return new_params, new_state
 
 
@@ -280,9 +417,11 @@ class DistributedFusedAdam(_DistributedOptimizer):
         weight_decay: float = 0.0,
         axis_name: Any = DATA_PARALLEL_AXIS,
         compressed_allgather: Optional[str] = None,
+        param_specs: Any = None,
     ):
         super().__init__(lr=lr, axis_name=axis_name,
-                         compressed_allgather=compressed_allgather)
+                         compressed_allgather=compressed_allgather,
+                         param_specs=param_specs)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -307,6 +446,28 @@ class DistributedFusedAdam(_DistributedOptimizer):
             update = update + wd * p
         return p - lr * update, {"exp_avg": m, "exp_avg_sq": v}
 
+    def _local_update(self, extra, step, g, p, lr):
+        """Adam on the rank-local (data-axis-sharded) leaves — the
+        identical elementwise math as :meth:`_update_shard`, applied
+        per leaf via tree.map (Adam has no cross-leaf coupling, so
+        locality is exact; tree.map also validates the trees'
+        structures agree, which a zip would not)."""
+        triple = jax.tree.map(
+            lambda pi, gi, mi, vi: self._update_shard(
+                {"exp_avg": mi, "exp_avg_sq": vi}, step, gi, pi, lr,
+                meta=None, ids_local=None,
+            ),
+            p, g, extra["exp_avg"], extra["exp_avg_sq"],
+        )
+        is_pair = lambda x: (isinstance(x, tuple) and len(x) == 2
+                             and isinstance(x[1], dict))
+        new_p = jax.tree.map(lambda t: t[0], triple, is_leaf=is_pair)
+        new_m = jax.tree.map(lambda t: t[1]["exp_avg"], triple,
+                             is_leaf=is_pair)
+        new_v = jax.tree.map(lambda t: t[1]["exp_avg_sq"], triple,
+                             is_leaf=is_pair)
+        return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}
+
 
 class DistributedFusedLAMB(_DistributedOptimizer):
     """Sharded LAMB with exact per-parameter trust ratios
@@ -327,9 +488,11 @@ class DistributedFusedLAMB(_DistributedOptimizer):
         use_nvlamb: bool = False,
         axis_name: Any = DATA_PARALLEL_AXIS,
         compressed_allgather: Optional[str] = None,
+        param_specs: Any = None,
     ):
         super().__init__(lr=lr, axis_name=axis_name,
-                         compressed_allgather=compressed_allgather)
+                         compressed_allgather=compressed_allgather,
+                         param_specs=param_specs)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps

@@ -287,3 +287,104 @@ class TestCompressedAllGather:
     def test_bad_format_rejected(self):
         with pytest.raises(ValueError):
             DistributedFusedAdam(lr=1e-2, compressed_allgather="int4")
+
+
+class TestDataAxisShardedLeaves:
+    """MoE composition: expert weights ride "dp" as the ep axis, so they
+    are data-axis-SHARDED — the flat RS/AG path would sum unrelated
+    expert shards.  With param_specs, DistributedFusedAdam updates them
+    rank-locally (their grads are already complete on the owner)."""
+
+    def test_moe_expert_leaves_update_locally(self, mesh):
+        H, E_local = 6, 2
+        specs = {"dense": P(), "experts": P("dp", None, None)}
+        k = jax.random.PRNGKey(0)
+        dense = jax.random.normal(k, (H, H))
+        # per-rank DISTINCT expert shards: global (8*E_local, H, H)
+        experts = jax.random.normal(jax.random.fold_in(k, 1),
+                                    (8 * E_local, H, H))
+        dense_grads_per_rank = 0.1 * jax.random.normal(
+            jax.random.fold_in(k, 2), (8, H, H))
+        expert_grads = 0.1 * jax.random.normal(
+            jax.random.fold_in(k, 3), (8 * E_local, H, H))
+
+        opt = DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                                   param_specs=specs)
+        sspecs = opt.state_specs()
+        pspec = specs
+
+        def init_fn(p):
+            return opt.init(p)
+
+        init = jax.jit(jax.shard_map(
+            init_fn, mesh=mesh, in_specs=(pspec,), out_specs=sspecs))
+
+        params = {"dense": dense, "experts": experts}
+        # dense grads are handed in stacked (8, H, H) and sharded over
+        # dp, so each rank sees a DIFFERENT (1, H, H) slice — squeezed
+        # to (H, H) inside; the RS path must average them
+        grads = {"dense": dense_grads_per_rank,
+                 "experts": expert_grads}
+
+        def step_squeeze(state, grads, params):
+            g = {"dense": grads["dense"][0], "experts": grads["experts"]}
+            return opt.step(state, g, params)
+
+        step = jax.jit(jax.shard_map(
+            step_squeeze, mesh=mesh,
+            in_specs=(sspecs,
+                      {"dense": P("dp"), "experts": P("dp")},
+                      pspec),
+            out_specs=(pspec, sspecs),
+        ))
+        state = init(params)
+        new_params, new_state = step(state, grads, params)
+
+        # reference: dense uses the dp-MEAN of the per-rank grads (the
+        # RS path averages); under the raw convention the expert grads
+        # (the all_to_all SUM) are likewise divided by world — both
+        # plain AdamW
+        ref_opt = FusedAdam(lr=1e-2, weight_decay=0.01,
+                            master_weights=True)
+        ref_state = ref_opt.init(params)
+        ref_grads = {"dense": jnp.mean(dense_grads_per_rank, axis=0),
+                     "experts": expert_grads / 8.0}
+        ref_params, _ = ref_opt.step(ref_state, ref_grads, params)
+        np.testing.assert_allclose(
+            np.asarray(new_params["dense"]),
+            np.asarray(ref_params["dense"]), rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(
+            np.asarray(new_params["experts"]),
+            np.asarray(ref_params["experts"]), rtol=1e-6, atol=1e-7)
+
+        # prenormalized convention: expert grads pass through unscaled
+        step_pre = jax.jit(jax.shard_map(
+            lambda st, g, pr: opt.step(
+                st, {"dense": g["dense"][0], "experts": g["experts"]},
+                pr, local_grads_prenormalized=True),
+            mesh=mesh,
+            in_specs=(sspecs, {"dense": P("dp"), "experts": P("dp")},
+                      pspec),
+            out_specs=(pspec, sspecs),
+        ))
+        state2 = init(params)
+        pre_params, _ = step_pre(state2, grads, params)
+        ref_grads_pre = {"dense": jnp.mean(dense_grads_per_rank, axis=0),
+                         "experts": expert_grads}
+        ref_state2 = ref_opt.init(params)
+        ref_pre, _ = ref_opt.step(ref_state2, ref_grads_pre, params)
+        np.testing.assert_allclose(
+            np.asarray(pre_params["experts"]),
+            np.asarray(ref_pre["experts"]), rtol=1e-6, atol=1e-7)
+
+    def test_lamb_rejects_data_sharded_leaves(self):
+        # fail-fast: at CONSTRUCTION, not at step-trace time
+        with pytest.raises(NotImplementedError):
+            DistributedFusedLAMB(lr=1e-2,
+                                 param_specs={"w": P(), "e": P("dp")})
+
+    def test_hierarchical_rejects_data_sharded_leaves(self):
+        with pytest.raises(NotImplementedError):
+            DistributedFusedAdam(
+                lr=1e-2, axis_name=("dcn", "ici"),
+                param_specs={"w": P(), "e": P("ici")})
