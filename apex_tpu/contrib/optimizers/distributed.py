@@ -152,26 +152,29 @@ class _DistributedOptimizer:
         # shards); they get a rank-LOCAL fp32-master update instead,
         # selected by whether the leaf's spec names the shard axis
         self.param_specs = param_specs
-        if param_specs is not None:
-            mask = self._local_mask()
-            if self._has_local(mask):
-                # fail FAST, not at step-trace time
-                if self._hierarchical:
-                    raise NotImplementedError(
-                        "data-axis-sharded leaves are not supported with "
-                        "a hierarchical axis_name: the rank-local path "
-                        "performs no collectives, so the cross-axis "
-                        "(dcn) replicas would silently diverge"
-                    )
-                if (type(self)._local_update
-                        is _DistributedOptimizer._local_update):
-                    raise NotImplementedError(
-                        f"{type(self).__name__} does not support "
-                        "data-axis-sharded params (its update couples "
-                        "leaves globally, e.g. the LAMB grad-norm "
-                        "clip); use DistributedFusedAdam for MoE "
-                        "expert-parallel models or drop param_specs"
-                    )
+        # cached at construction: pure function of (param_specs, axes)
+        self._mask = (self._local_mask()
+                      if param_specs is not None else None)
+        if self._mask is not None and self._has_local(self._mask):
+            # fail FAST, not at step-trace time
+            if self._hierarchical:
+                raise NotImplementedError(
+                    "data-axis-sharded leaves are not supported with "
+                    "a hierarchical axis_name: the rank-local path "
+                    "performs no collectives, so the cross-axis "
+                    "(dcn) replicas would silently diverge"
+                )
+            if (type(self)._local_update
+                    is _DistributedOptimizer._local_update):
+                raise NotImplementedError(
+                    f"{type(self).__name__} does not support "
+                    "data-axis-sharded params (its update couples "
+                    "leaves globally, e.g. the LAMB grad-norm "
+                    "clip); use DistributedFusedAdam for MoE "
+                    "expert-parallel models or drop param_specs"
+                )
+        else:
+            self._mask = None  # no local leaves: one uniform flat path
 
     # ---------------------------------------------------- local leaves
     def _local_mask(self):
@@ -251,19 +254,20 @@ class _DistributedOptimizer:
         specs = {k: P(ax) for k in self._extra_init(1)}
         specs["step"] = P()
         specs["master"] = P(ax)
-        if self.param_specs is not None:
-            mask = self._local_mask()
-            if self._has_local(mask):
-                # data-axis-sharded leaves keep the PARAM's own spec
-                # (their state lives where the shard lives); the
-                # replicated half's placeholders are 0-size → P()
-                lspec = jax.tree.map(
-                    lambda m, s: s if m else P(),
-                    mask, self.param_specs,
-                )
-                specs["local"] = {"master": lspec,
-                                  **{k: lspec
-                                     for k in self._extra_init(1)}}
+        if self._mask is not None:
+            # data-axis-sharded leaves keep the PARAM's own spec: their
+            # state lives exactly where the shard lives.  NOTE the spec
+            # must fully describe the leaf's model-axis sharding too
+            # (true for the models here: pipeline expert stacks are
+            # P("pp", ..., "dp", ...)); the replicated half's 0-size
+            # placeholders are P()
+            lspec = jax.tree.map(
+                lambda m, s: s if m else P(),
+                self._mask, self.param_specs,
+            )
+            moment_keys = list(self._extra_init(1))
+            specs["local"] = {"master": lspec,
+                              **{k: lspec for k in moment_keys}}
         return specs
 
     def init(self, params: Any) -> dict:
@@ -273,11 +277,9 @@ class _DistributedOptimizer:
         With ``param_specs`` given, data-axis-sharded leaves get a
         rank-local fp32 master + moments instead (see __init__)."""
         local_tree = None
-        if self.param_specs is not None:
-            mask = self._local_mask()
-            if self._has_local(mask):
-                local_tree = self._mask_tree(params, mask, True)
-                params = self._mask_tree(params, mask, False)
+        if self._mask is not None:
+            local_tree = self._mask_tree(params, self._mask, True)
+            params = self._mask_tree(params, self._mask, False)
         world = lax.axis_size(self._shard_axis)
         rank = lax.axis_index(self._shard_axis)
         meta = _FlatMeta(params, world)
@@ -321,13 +323,11 @@ class _DistributedOptimizer:
         the division.
         """
         local_params = local_grads = None
-        if self.param_specs is not None:
-            mask = self._local_mask()
-            if self._has_local(mask):
-                local_params = self._mask_tree(params, mask, True)
-                local_grads = self._mask_tree(grads, mask, True)
-                params = self._mask_tree(params, mask, False)
-                grads = self._mask_tree(grads, mask, False)
+        if self._mask is not None:
+            local_params = self._mask_tree(params, self._mask, True)
+            local_grads = self._mask_tree(grads, self._mask, True)
+            params = self._mask_tree(params, self._mask, False)
+            grads = self._mask_tree(grads, self._mask, False)
         world = lax.axis_size(self._shard_axis)
         rank = lax.axis_index(self._shard_axis)
         meta = _FlatMeta(params, world)
@@ -398,7 +398,7 @@ class _DistributedOptimizer:
             )
             new_params = jax.tree.map(
                 lambda is_local, a, b: b if is_local else a,
-                mask, new_params, local_out,
+                self._mask, new_params, local_out,
             )
         return new_params, new_state
 
@@ -449,24 +449,24 @@ class DistributedFusedAdam(_DistributedOptimizer):
     def _local_update(self, extra, step, g, p, lr):
         """Adam on the rank-local (data-axis-sharded) leaves — the
         identical elementwise math as :meth:`_update_shard`, applied
-        per leaf via tree.map (Adam has no cross-leaf coupling, so
-        locality is exact; tree.map also validates the trees'
-        structures agree, which a zip would not)."""
-        triple = jax.tree.map(
-            lambda pi, gi, mi, vi: self._update_shard(
+        per leaf (Adam has no cross-leaf coupling, so locality is
+        exact; the strict zip errors on any leaf-count mismatch)."""
+        flat_p, treedef = jax.tree_util.tree_flatten(p)
+        out_p, out_m, out_v = [], [], []
+        for pi, gi, mi, vi in zip(
+            flat_p, jax.tree.leaves(g), jax.tree.leaves(extra["exp_avg"]),
+            jax.tree.leaves(extra["exp_avg_sq"]), strict=True,
+        ):
+            npi, upd = self._update_shard(
                 {"exp_avg": mi, "exp_avg_sq": vi}, step, gi, pi, lr,
                 meta=None, ids_local=None,
-            ),
-            p, g, extra["exp_avg"], extra["exp_avg_sq"],
-        )
-        is_pair = lambda x: (isinstance(x, tuple) and len(x) == 2
-                             and isinstance(x[1], dict))
-        new_p = jax.tree.map(lambda t: t[0], triple, is_leaf=is_pair)
-        new_m = jax.tree.map(lambda t: t[1]["exp_avg"], triple,
-                             is_leaf=is_pair)
-        new_v = jax.tree.map(lambda t: t[1]["exp_avg_sq"], triple,
-                             is_leaf=is_pair)
-        return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}
+            )
+            out_p.append(npi)
+            out_m.append(upd["exp_avg"])
+            out_v.append(upd["exp_avg_sq"])
+        unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
+        return unf(out_p), {"exp_avg": unf(out_m),
+                            "exp_avg_sq": unf(out_v)}
 
 
 class DistributedFusedLAMB(_DistributedOptimizer):

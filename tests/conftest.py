@@ -34,6 +34,13 @@ import pytest  # noqa: E402
 # test_combined_axes); individual heavyweights live here so the split
 # stays visible in one place.
 SLOW_TESTS = {
+    "test_moe_aux_threads_through_pipeline",
+    "test_encdec_fused_1f1b_grads_match_gpipe_pp4",
+    "test_ring_grads_match_dense",
+    "test_no_pipelining_matches_serial",
+    "test_varlen_matches_per_sequence",
+    "test_loss_grad_finite",
+    "test_flash_kernels_fwd_bwd",
     "test_example_runs",
     "test_resnet50_builds",
     "test_forward_shapes_and_stats_update",
