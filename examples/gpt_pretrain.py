@@ -69,6 +69,8 @@ def main(argv=None):
     ap.add_argument("--zero", action="store_true",
                     help="shard optimizer state over dp "
                          "(DistributedFusedAdam)")
+    ap.add_argument("--num-experts", type=int, default=None,
+                    help="Switch-MoE experts riding dp as the ep axis")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--save-every", type=int, default=25)
     args = ap.parse_args(argv)
@@ -83,6 +85,8 @@ def main(argv=None):
         vocab_size=args.vocab, num_layers=args.layers,
         hidden_size=args.hidden, num_attention_heads=args.heads,
         max_position_embeddings=args.seq, policy=mp.policy,
+        num_experts=args.num_experts,
+        moe_capacity_factor=2.0,  # read only when num_experts is set
     )
     model = GPTModel(cfg)
     pp_path = args.pp > 1
@@ -101,7 +105,9 @@ def main(argv=None):
             reestablish_replicated,
         )
 
-        opt = DistributedFusedAdam(lr=args.lr)
+        # param_specs routes MoE expert leaves (dp-sharded as ep)
+        # through the rank-local update instead of the flat RS/AG
+        opt = DistributedFusedAdam(lr=args.lr, param_specs=specs)
         opt_specs = opt.state_specs(model_axes=("pp", "tp"))
         init_opt = jax.jit(jax.shard_map(
             opt.init, mesh=mesh, in_specs=(specs,), out_specs=opt_specs))
@@ -133,18 +139,37 @@ def main(argv=None):
             grads, loss = jax.grad(loss_fn, has_aux=True)(params)
             loss = jax.lax.pmean(loss, "dp")
             if not args.zero:
-                # ZeRO's reduce-scatter IS the dp reduction — a pmean
-                # here would pay the all-reduce ZeRO exists to remove
+                # spec-aware dp sync: replicated leaves pmean (a no-op
+                # re-establishing invariance — model.loss's internal
+                # pmean already made their grads globally complete);
+                # dp-SHARDED leaves (MoE experts riding dp as ep) are
+                # already final via the all_to_all transpose and must
+                # NOT be averaged elementwise across unrelated experts.
+                # ZeRO skips this: its reduce-scatter is the reduction
+                from apex_tpu.transformer.parallel_state import (
+                    spec_axis_names,
+                )
+
                 grads = jax.tree.map(
-                    lambda g: jax.lax.pmean(g, "dp"), grads)
+                    lambda g, sp: (g if "dp" in spec_axis_names(sp)
+                                   else jax.lax.pmean(g, "dp")),
+                    grads, specs,
+                )
         if use_scaler:
             grads, finite, amp_state = mp.unscale_and_adjust(
                 amp_state, grads, finite_reduce=model_parallel_all_finite)
         else:
             finite = None
         if args.zero:
+            # expert grads are optimizer-ready in BOTH paths here: the
+            # pipeline's data_reduce applies the 1/n itself, and the
+            # pp=1 path's model.loss pmeans the loss inside the
+            # differentiated function (the all_to_all transpose then
+            # delivers the final global-mean gradient) — so the local
+            # path must not divide again
             new_params, new_opt = opt.step(
-                opt_state, grads, params, grads_finite=finite)
+                opt_state, grads, params, grads_finite=finite,
+                local_grads_prenormalized=True)
             new_params = reestablish_replicated(new_params, specs)
         else:
             new_params, new_opt = opt.step(

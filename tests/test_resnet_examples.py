@@ -103,6 +103,15 @@ class TestResNet:
           "--layers", "2", "--hidden", "32", "--heads", "4",
           "--seq", "16", "--micro-batch", "1", "--steps", "3",
           "--zero", "--opt-level", "O2"]),
+        ("examples/gpt_pretrain.py",
+         ["--pp", "2", "--num-micro", "2", "--vocab", "64",
+          "--layers", "2", "--hidden", "32", "--heads", "4",
+          "--seq", "16", "--micro-batch", "1", "--steps", "3",
+          "--zero", "--num-experts", "8"]),
+        ("examples/gpt_pretrain.py",
+         ["--vocab", "64", "--layers", "2", "--hidden", "32",
+          "--heads", "4", "--seq", "16", "--micro-batch", "1",
+          "--steps", "3", "--num-experts", "8"]),
     ],
 )
 def test_example_runs(script, args):
