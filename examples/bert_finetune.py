@@ -1,0 +1,151 @@
+"""BERT fine-tuning — sequence classification with the binary head.
+
+The reference ships BERT only as a Megatron-toolkit test model; this is
+the end-user walkthrough it implies: take the pretrained-style
+`BertModel` (bidirectional encoder, [CLS] pooler, varlen attention
+masks), put its 2-way head on a downstream classification task, and
+fine-tune with the O4-analog policy (bf16 compute, fp32 params — the
+usual fine-tuning precision).
+
+Synthetic separable task by default: each "sentence" is classified by
+whether its first real token falls in the upper half of the vocab, with
+randomly padded lengths so the attention-mask/varlen path is genuinely
+exercised.  Accuracy climbs from chance to ~100% in a few hundred
+steps; swap :func:`synthetic_task` for a real tokenized dataset.
+
+    python examples/bert_finetune.py --steps 200 --tp 2
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.models import BertConfig, BertModel
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel.layers import state_specs_like
+
+
+def synthetic_task(rng, n_batches, global_batch, seq, vocab):
+    """Variable-length sequences; label = first token in upper vocab
+    half.  Returns a list of (tokens, mask, labels)."""
+    pool = []
+    for _ in range(n_batches):
+        tokens = rng.integers(1, vocab, (global_batch, seq))
+        lengths = rng.integers(seq // 2, seq + 1, (global_batch,))
+        mask = np.arange(seq)[None, :] < lengths[:, None]
+        tokens = np.where(mask, tokens, 0)
+        labels = (tokens[:, 0] >= vocab // 2).astype(np.int32)
+        pool.append((jnp.asarray(tokens, jnp.int32),
+                     jnp.asarray(mask),
+                     jnp.asarray(labels, jnp.int32)))
+    return pool
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=4,
+                    help="per-dp-rank batch rows")
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--eval-batches", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--opt-level", default="O4")
+    args = ap.parse_args(argv)
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=args.tp)
+    dp = mesh.shape["dp"]
+    mp = amp.initialize(opt_level=args.opt_level)
+    cfg = BertConfig(
+        vocab_size=args.vocab, num_layers=args.layers,
+        hidden_size=args.hidden, num_attention_heads=args.heads,
+        max_position_embeddings=args.seq, policy=mp.policy,
+        add_binary_head=True,
+    )
+    model = BertModel(cfg)
+    specs = model.param_specs()
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=args.lr,
+                    master_weights=mp.policy.master_weights)
+    opt_state = opt.init(params)
+    opt_specs = state_specs_like(specs, opt_state)
+
+    def cls_loss(p, tokens, mask, labels):
+        hidden = model.encode(p, tokens, attention_mask=mask)
+        logits = model.binary_logits(p, hidden)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, None], 1)[:, 0]
+        acc = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        return (jax.lax.pmean(jnp.mean(nll), "dp"),
+                jax.lax.pmean(jnp.mean(acc), "dp"))
+
+    def train_step(p, s, tokens, mask, labels):
+        (loss, acc), grads = jax.value_and_grad(
+            cls_loss, has_aux=True)(p, tokens, mask, labels)
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+        p, s = opt.step(s, grads, p)
+        return p, s, loss, acc
+
+    data_spec = P("dp")
+    jstep = jax.jit(
+        jax.shard_map(
+            train_step, mesh=mesh,
+            in_specs=(specs, opt_specs, data_spec, data_spec, data_spec),
+            out_specs=(specs, opt_specs, P(), P()),
+        ),
+        donate_argnums=(0, 1),
+    )
+    jeval = jax.jit(jax.shard_map(
+        cls_loss, mesh=mesh,
+        in_specs=(specs, data_spec, data_spec, data_spec),
+        out_specs=(P(), P()),
+    ))
+
+    place = lambda t, sp: jax.device_put(
+        t, jax.tree.map(lambda s: NamedSharding(mesh, s), sp,
+                        is_leaf=lambda x: isinstance(x, P)))
+    p, s = place(params, specs), place(opt_state, opt_specs)
+    global_batch = args.batch * dp
+    rng = np.random.default_rng(0)
+    # pool large enough that most of the vocab appears in position 0,
+    # so eval measures the learned rule rather than memorized rows
+    train_pool = synthetic_task(rng, 64, global_batch, args.seq,
+                                args.vocab)
+    eval_pool = synthetic_task(np.random.default_rng(1),
+                               args.eval_batches, global_batch,
+                               args.seq, args.vocab)
+
+    t0, timed = None, 0
+    for i in range(args.steps):
+        tokens, mask, labels = train_pool[i % len(train_pool)]
+        p, s, loss, acc = jstep(p, s, tokens, mask, labels)
+        lv = float(loss)
+        if i == 0:
+            t0 = time.perf_counter()
+        else:
+            timed += 1
+        if i % 50 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss {lv:.4f}  train-acc {float(acc):.3f}")
+    if timed and t0:
+        dt = time.perf_counter() - t0
+        print(f"{dt / timed * 1e3:.1f} ms/step  "
+              f"{global_batch * timed / dt:,.0f} seq/s")
+
+    accs = [float(jeval(p, *b)[1]) for b in eval_pool]
+    print(f"eval accuracy: {np.mean(accs):.3f}")
+    return {"eval_accuracy": float(np.mean(accs))}
+
+
+if __name__ == "__main__":
+    main()

@@ -156,8 +156,15 @@ def main(argv=None):
                     grads, specs,
                 )
         if use_scaler:
+            # MoE: expert grads differ per dp rank, so the overflow
+            # verdict must ALSO reach dp consensus or ranks would skip
+            # steps independently and desync replicated params
+            axes = (("tp", "pp", "dp") if args.num_experts
+                    else ("tp", "pp"))
             grads, finite, amp_state = mp.unscale_and_adjust(
-                amp_state, grads, finite_reduce=model_parallel_all_finite)
+                amp_state, grads,
+                finite_reduce=lambda f: model_parallel_all_finite(
+                    f, axis_names=axes))
         else:
             finite = None
         if args.zero:

@@ -112,6 +112,14 @@ class TestResNet:
          ["--vocab", "64", "--layers", "2", "--hidden", "32",
           "--heads", "4", "--seq", "16", "--micro-batch", "1",
           "--steps", "3", "--num-experts", "8"]),
+        ("examples/gpt_pretrain.py",
+         ["--vocab", "64", "--layers", "2", "--hidden", "32",
+          "--heads", "4", "--seq", "16", "--micro-batch", "1",
+          "--steps", "3", "--num-experts", "8", "--opt-level", "O2"]),
+        ("examples/bert_finetune.py",
+         ["--tp", "2", "--vocab", "64", "--layers", "1",
+          "--hidden", "32", "--heads", "2", "--seq", "16",
+          "--batch", "1", "--steps", "3", "--eval-batches", "1"]),
     ],
 )
 def test_example_runs(script, args):
