@@ -1249,11 +1249,12 @@ def _fwd_bwd_encdec(
     fused_stage_fn: Optional[Callable] = None,
 ) -> tuple:
     """Encoder-decoder pipeline in the dispatched ``(losses, grads)``
-    contract: :func:`pipeline_encdec` differentiated through one vjp
-    (GPipe-memory — there is no enc-dec 1F1B yet; the reference's
-    enc-dec path likewise schedules without interleaving,
-    schedules/common.py:18-108).  Params are cast varying over the data
-    axes so grads are shard-local, the family's shared dp convention
+    contract.  The two-stream fallback is :func:`pipeline_encdec`
+    differentiated through one vjp (GPipe-memory, matching the
+    reference's non-interleaved enc-dec scheduling,
+    schedules/common.py:18-108); the fused route below runs TRUE
+    enc-dec 1F1B.  Params are cast varying over the data axes so grads
+    are shard-local, the family's shared dp convention
     (see :func:`_fwd_bwd_no_pipelining`).
 
     ``fused_stage_fn(params, x, mem, stage)``, if given, routes through
