@@ -25,6 +25,11 @@ optimizer.
 
 Call :meth:`init` and :meth:`step` inside ``shard_map``; state specs come
 from :meth:`state_specs`.
+
+MoE composition: pass ``param_specs=`` to :class:`DistributedFusedAdam`
+and leaves whose spec names the data axis (expert weights riding "dp"
+as ep) are updated rank-locally with fp32 masters instead of riding the
+flat buffer — see the class docs and docs/optimizers.md.
 """
 
 from __future__ import annotations
