@@ -712,13 +712,7 @@ class GPTModel:
         grads = sync_replicated_grads(grads, specs)
         loss = jax.lax.pmean(jnp.mean(losses), DATA_PARALLEL_AXIS)
 
-        def spec_axes(s):
-            out = set()
-            for part in s:
-                if part is None:
-                    continue
-                out |= set(part) if isinstance(part, tuple) else {part}
-            return out
+        from apex_tpu.transformer.parallel_state import spec_axis_names
 
         def data_reduce(s, g, axis):
             # the schedule's grads are this data shard's contribution to
@@ -729,7 +723,7 @@ class GPTModel:
             # transpose already accumulated every shard's contribution
             # into the owner, so the mean is just the 1/n scale.
             n = jax.lax.axis_size(axis)
-            if axis in spec_axes(s):
+            if axis in spec_axis_names(s):
                 return g / n
             return jax.lax.pmean(g, axis)
 

@@ -50,10 +50,15 @@ class AutoResume:
 
     # -------------------------------------------------------------- save
     def _gc(self) -> None:
+        import re
+
+        # fullmatch, as in checkpoint.latest_step: a crashed atomic
+        # writer leaves a step_<N>.tmp husk that must neither crash the
+        # int() parse nor count as a checkpoint
         steps = sorted(
-            int(d.split("_", 1)[1])
+            int(m.group(1))
             for d in os.listdir(self.root)
-            if d.startswith("step_")
+            if (m := re.fullmatch(r"step_(\d+)", d))
         )
         for old in steps[: -self.keep]:
             shutil.rmtree(

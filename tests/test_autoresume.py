@@ -44,3 +44,18 @@ def test_termination_request_forces_save(tmp_path):
     assert ar.maybe_save(4, {"w": jnp.zeros(2)})
     _, step = AutoResume(root).resume()
     assert step == 4
+
+
+def test_gc_ignores_tmp_husks(tmp_path):
+    """A crashed atomic writer's step_<N>.tmp husk must not crash GC or
+    count as a checkpoint (checkpoint.save writes into .tmp + rename)."""
+    from apex_tpu.utils.autoresume import AutoResume
+
+    ar = AutoResume(str(tmp_path), interval_steps=1, keep=2)
+    for step in (1, 2, 3):
+        ar.maybe_save(step, {"v": jnp.float32(step)})
+    (tmp_path / "step_9.tmp").mkdir()  # simulated mid-write crash
+    assert ar.maybe_save(4, {"v": jnp.float32(4)})  # _gc must not raise
+    state, step = ar.resume()
+    assert step == 4
+    assert float(state["v"]) == 4.0
