@@ -238,8 +238,7 @@ def _fa_fwd_kernel(
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    @pl.when(kb <= last_kb)
-    def _compute():
+    def _body(masked):
         q = q_ref[0].astype(jnp.float32) * cfg.sm_scale    # (block_q, d)
         kblk = k_ref[0].astype(jnp.float32)                # (block_k, d)
         vblk = v_ref[0].astype(jnp.float32)
@@ -250,24 +249,28 @@ def _fa_fwd_kernel(
         )                                                  # (block_q, block_k)
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
-        q_global = j * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0
-        )
-        k_global = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1
-        )
-        mask = k_global < cfg.kv_len
-        if cfg.causal:
-            mask = jnp.logical_and(mask, k_global <= q_global)
-        if has_segs:
-            mask = jnp.logical_and(
-                mask, qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
+        if masked or has_dropout:
+            q_global = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
             )
+            k_global = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+        if masked:
+            mask = k_global < cfg.kv_len
+            if cfg.causal:
+                mask = jnp.logical_and(mask, k_global <= q_global)
+            if has_segs:
+                mask = jnp.logical_and(
+                    mask, qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
+                )
+            s = jnp.where(mask, s, _NEG_INF)
         m_prev = m_ref[:, 0:1]
         l_prev = l_ref[:, 0:1]
-        s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        p = jnp.exp(s - m_new)
+        if masked:
+            p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         if has_dropout:
@@ -285,6 +288,13 @@ def _fa_fwd_kernel(
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    conds = []
+    if cfg.causal:
+        conds.append(kb * block_k + (block_k - 1) > j * block_q)
+    if cfg.kv_len < num_k * block_k:                        # kv padding
+        conds.append(kb == num_k - 1)
+    _mask_specialized(kb <= last_kb, conds, has_segs, _body)
 
     @pl.when(kb == last_kb)
     def _finalize():
@@ -349,10 +359,28 @@ def _compiler_params():
     )
 
 
+def _mask_specialized(run, conds, has_segs, body):
+    """Emit ``body(masked=...)`` under ``pl.when`` with mask
+    specialization: blocks matching no condition in ``conds`` (causal
+    diagonal, padded tail) take the mask-free path — skipping the
+    iota/compare/where chain that bounds kernel throughput on the VPU.
+    Segment ids force the masked path everywhere; an empty ``conds``
+    (non-causal, unpadded) makes every block mask-free."""
+    if has_segs or not conds:
+        pl.when(run)(lambda: body(masked=bool(has_segs or conds)))
+    else:
+        need = functools.reduce(jnp.logical_or, conds)
+        pl.when(jnp.logical_and(run, need))(lambda: body(masked=True))
+        pl.when(jnp.logical_and(run, jnp.logical_not(need)))(
+            lambda: body(masked=False))
+
+
 def _fa_fwd_pallas(q, k, v, bias, qseg, kseg, seed, cfg: _FAConfig):
     bh, psq, d = q.shape
     psk = k.shape[1]
     num_q, num_k = psq // cfg.block_q, psk // cfg.block_k
+    # mask specialization assumes padding is confined to the final block
+    assert psk - cfg.kv_len < cfg.block_k and psq - cfg.q_len < cfg.block_q
     has_bias = bias is not None
     has_segs = qseg is not None
     has_dropout = cfg.dropout_rate > 0.0
@@ -420,8 +448,7 @@ def _fa_bwd_dkv_kernel(
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    @pl.when(jq >= first_jq)
-    def _compute():
+    def _body(masked):
         kblk = k_ref[0].astype(jnp.float32)                # (block_k, d)
         vblk = v_ref[0].astype(jnp.float32)
         qblk = q_ref[0].astype(jnp.float32)                # (block_q, d)
@@ -435,20 +462,25 @@ def _fa_bwd_dkv_kernel(
         ) * cfg.sm_scale                                   # (block_q, block_k)
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
-        q_global = jq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0
-        )
-        k_global = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1
-        )
-        mask = jnp.logical_and(q_global < cfg.q_len, k_global < cfg.kv_len)
-        if cfg.causal:
-            mask = jnp.logical_and(mask, k_global <= q_global)
-        if has_segs:
-            mask = jnp.logical_and(
-                mask, qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
+        if masked or has_dropout:
+            q_global = jq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
             )
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            k_global = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+        p = jnp.exp(s - lse)
+        if masked:
+            mask = jnp.logical_and(
+                q_global < cfg.q_len, k_global < cfg.kv_len
+            )
+            if cfg.causal:
+                mask = jnp.logical_and(mask, k_global <= q_global)
+            if has_segs:
+                mask = jnp.logical_and(
+                    mask, qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
+                )
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             doblk, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -475,6 +507,21 @@ def _fa_bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
             precision=_prec(cfg),
         )
+
+    # Grid roles swapped vs fwd/dq: a q block needs masking iff it
+    # intersects the causal diagonal (k_max > q_min) or is the
+    # (actually padded) q tail block.  The q-padding mask is
+    # load-bearing here — padded q rows carry garbage lse/delta and
+    # would otherwise pollute the dk/dv sums — so the tail condition
+    # uses jq, not kb.  Padded *k* rows only produce garbage in dk/dv
+    # rows that the caller slices off, so kv padding needs no condition
+    # in this kernel.
+    conds = []
+    if cfg.causal:
+        conds.append(kb * block_k + (block_k - 1) > jq * block_q)
+    if cfg.q_len < num_q * block_q:                         # q padding
+        conds.append(jq == num_q - 1)
+    _mask_specialized(jq >= first_jq, conds, has_segs, _body)
 
     @pl.when(jq == num_q - 1)
     def _finalize():
@@ -517,8 +564,7 @@ def _fa_bwd_dq_kernel(
     emit_dbias = dbias_ref is not None
     run = (kb <= last_kb) if not emit_dbias else (kb <= num_k - 1)
 
-    @pl.when(run)
-    def _compute():
+    def _body(masked):
         qblk = q_ref[0].astype(jnp.float32)
         kblk = k_ref[0].astype(jnp.float32)
         vblk = v_ref[0].astype(jnp.float32)
@@ -532,20 +578,23 @@ def _fa_bwd_dq_kernel(
         ) * cfg.sm_scale
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
-        q_global = j * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0
-        )
-        k_global = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1
-        )
-        mask = k_global < cfg.kv_len
-        if cfg.causal:
-            mask = jnp.logical_and(mask, k_global <= q_global)
-        if has_segs:
-            mask = jnp.logical_and(
-                mask, qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
+        if masked or has_dropout:
+            q_global = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
             )
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            k_global = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+        p = jnp.exp(s - lse)
+        if masked:
+            mask = k_global < cfg.kv_len
+            if cfg.causal:
+                mask = jnp.logical_and(mask, k_global <= q_global)
+            if has_segs:
+                mask = jnp.logical_and(
+                    mask, qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
+                )
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             doblk, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -566,6 +615,16 @@ def _fa_bwd_dq_kernel(
             precision=_prec(cfg),
         )
 
+    # The emit_dbias path runs above-diagonal blocks too, where the mask
+    # is what zeroes dz — those blocks stay on the masked path via the
+    # diagonal condition (their k exceeds q).
+    conds = []
+    if cfg.causal:
+        conds.append(kb * block_k + (block_k - 1) > j * block_q)
+    if cfg.kv_len < num_k * block_k:                        # kv padding
+        conds.append(kb == num_k - 1)
+    _mask_specialized(run, conds, has_segs, _body)
+
     write_kb = (num_k - 1) if emit_dbias else last_kb
 
     @pl.when(kb == write_kb)
@@ -578,6 +637,8 @@ def _fa_bwd_pallas(q, k, v, bias, qseg, kseg, seed, out, lse, do,
     bh, psq, d = q.shape
     psk = k.shape[1]
     num_q, num_k = psq // cfg.block_q, psk // cfg.block_k
+    # mask specialization assumes padding is confined to the final block
+    assert psk - cfg.kv_len < cfg.block_k and psq - cfg.q_len < cfg.block_q
     has_bias = bias is not None
     has_segs = qseg is not None
     has_dropout = cfg.dropout_rate > 0.0
