@@ -367,7 +367,7 @@ def _mask_specialized(run, conds, has_segs, body):
     Segment ids force the masked path everywhere; an empty ``conds``
     (non-causal, unpadded) makes every block mask-free."""
     if has_segs or not conds:
-        pl.when(run)(lambda: body(masked=bool(has_segs or conds)))
+        pl.when(run)(lambda: body(masked=bool(has_segs)))
     else:
         need = functools.reduce(jnp.logical_or, conds)
         pl.when(jnp.logical_and(run, need))(lambda: body(masked=True))

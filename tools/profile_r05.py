@@ -136,6 +136,7 @@ def main():
     n_params = rows[0]["n_params"]
     for label, kw in (
         ("fused_ce off", {"fused_ce": False}),
+        ("attention xla", {"attention_impl": "xla"}),
         ("remat off", {"remat": False}),
         ("remat dots_saveable", {"remat_policy": "dots_saveable"}),
         ("fwd only", {"_fwd_only": True}),
