@@ -49,9 +49,10 @@ class BertConfig:
     # an amp.Policy drives both dtypes (one-kwarg O0..O5 switch)
     policy: Optional[Any] = None
     remat: bool = True
-    # same measured defaults as GPTConfig (PROFILE_r03.md exps 1 and 5)
+    # same measured defaults as GPTConfig (PROFILE_r03.md exps 1 and 5;
+    # fused_ce None = auto by logits size, see GPTConfig)
     remat_policy: Optional[str] = "dots_with_no_batch_dims_saveable"
-    fused_ce: bool = True
+    fused_ce: Optional[bool] = None
     fused_ce_chunk: int = 8192
     add_binary_head: bool = True
     attention_impl: Optional[str] = None  # "pallas" | "xla" | None=auto

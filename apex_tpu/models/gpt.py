@@ -85,11 +85,12 @@ class GPTConfig:
     # remat off 111.7 — batch-dim dot outputs are cheap to recompute and
     # expensive to keep resident
     remat_policy: Optional[str] = "dots_with_no_batch_dims_saveable"
-    # fuse the LM head into the CE (logits never materialized) — the
-    # chunked online-logsumexp path in tensor_parallel.cross_entropy;
-    # measured −1.6 ms/step at chunk=8192 on the v5e bench config
-    # (PROFILE_r03.md exp 5)
-    fused_ce: bool = True
+    # LM-head/CE dispatch: None = auto by materialized-logits size
+    # (tensor_parallel.cross_entropy.FUSED_CE_AUTO_BYTES) — small logits
+    # take the two-step path (faster: 107.4 vs 110.1 ms/step at the v5e
+    # flagship, BENCH r4+r5 A/B), large ones the fused online-logsumexp
+    # scan that never materializes logits.  True/False forces a path.
+    fused_ce: Optional[bool] = None
     fused_ce_chunk: int = 8192
     attention_impl: Optional[str] = None  # None → pick by platform
     # shard the sequence dim over the "cp" mesh axis and use ring

@@ -74,9 +74,10 @@ class T5Config:
     # an amp.Policy drives the dtypes, as in GPTConfig/BertConfig
     policy: Optional[Any] = None
     remat: bool = True
-    # same measured defaults as GPTConfig (PROFILE_r03.md exps 1 and 5)
+    # same measured defaults as GPTConfig (PROFILE_r03.md exps 1 and 5;
+    # fused_ce None = auto by logits size, see GPTConfig)
     remat_policy: Optional[str] = "dots_with_no_batch_dims_saveable"
-    fused_ce: bool = True
+    fused_ce: Optional[bool] = None
     fused_ce_chunk: int = 8192
     attention_impl: Optional[str] = None
     # route the pipeline path through pipeline_encdec_fused: ONE

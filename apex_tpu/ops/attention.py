@@ -889,6 +889,29 @@ def flash_attention(
     )
 
 
+def _clamp_blocks(dtype, block_q: int, block_k: int):
+    """Clamp the (block_q, block_k) area for fp32 inputs.
+
+    The backward kernels keep several (block_q, block_k) fp32 score-space
+    temporaries live at once (s, p, dp, dz); at 1024x1024 fp32 blocks
+    that stack reaches ~18.3 MB and exceeds Mosaic's 16 MB scoped-vmem
+    limit (measured compile failure, r5 kernel sweep).  512x1024 — the
+    shipped default and the area every committed fp32 sweep row was
+    measured at — halves each temporary to 2 MB and compiles at every
+    benchmarked shape, so fp32 requests above that area are clamped
+    rather than left to fail in the compiler.  bf16 keeps the caller's
+    blocks: its temporaries stay fp32 in-kernel but the sweep shows
+    1024x1024 compiling and winning there (KERNELS_TPU.json).
+    """
+    if dtype == jnp.float32:
+        while block_q * block_k > 512 * 1024:
+            if block_q >= block_k:
+                block_q //= 2
+            else:
+                block_k //= 2
+    return block_q, block_k
+
+
 def _flash_attention_pallas(
     q, k, v, causal, sm_scale, bias, q_segment_ids, kv_segment_ids,
     dropout_rate, dropout_seed, bias_requires_grad, block_q, block_k,
@@ -896,6 +919,7 @@ def _flash_attention_pallas(
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = (1.0 / d**0.5) if sm_scale is None else float(sm_scale)
+    block_q, block_k = _clamp_blocks(q.dtype, block_q, block_k)
     block_q = min(block_q, max(sq, 1))
     block_k = min(block_k, max(sk, 1))
     pad_q = (-sq) % block_q

@@ -12,6 +12,8 @@ as a constant.
 from __future__ import annotations
 
 import functools
+import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +34,19 @@ __all__ = [
 # signatures previously disagreed (8192 vs 4096)
 FUSED_CE_DEFAULT_CHUNK = 8192
 
+# fused=None auto rule: below this materialized-logits size the two-step
+# path (one unchunked head einsum, logits live as a bwd residual) beats
+# the chunked online-logsumexp scan — the scan serializes the head
+# matmul into chunk-sized pieces and re-derives logits in the backward,
+# which only pays off once the (tokens, vocab_local) fp32 residual is
+# big enough to hit the HBM wall.  Measured on TPU v5 lite at the
+# flagship GPT config (8192 tokens x 32768 vocab = 1.07 GB residual):
+# two-step 107.4 ms/step vs fused@8192 110.1 — reproduced across two
+# chip sessions (BENCH r4+r5 A/B, LAST_TPU_BENCH.json ab.fused_ce).
+FUSED_CE_AUTO_BYTES = int(
+    os.environ.get("APEX_TPU_FUSED_CE_BYTES", str(2 << 30))
+)
+
 
 def _largest_chunk_divisor(v_local: int, chunk: int) -> int:
     """Largest divisor of ``v_local`` that is <= ``chunk`` — the fused
@@ -51,7 +66,7 @@ def lm_head_cross_entropy(
     targets: jnp.ndarray,
     *,
     axis_name: str = TENSOR_PARALLEL_AXIS,
-    fused: bool = True,
+    fused: "bool | None" = None,
     chunk: int = FUSED_CE_DEFAULT_CHUNK,
     bias: "jnp.ndarray | None" = None,
     smoothing: float = 0.0,
@@ -60,7 +75,16 @@ def lm_head_cross_entropy(
     dispatch shared by the GPT / BERT / T5 loss paths: the fused
     chunked path (:func:`vocab_parallel_cross_entropy_from_hidden`,
     logits never materialized) when ``fused``, else explicit logits +
-    :func:`vocab_parallel_cross_entropy`."""
+    :func:`vocab_parallel_cross_entropy`.
+
+    ``fused=None`` (default) picks by the materialized-logits residual
+    size against ``FUSED_CE_AUTO_BYTES``: small logits take the faster
+    two-step path, large ones the memory-bounded fused scan.  All
+    shapes here are the shard_map-local shard, so the rule composes
+    with tp (vocab/tp local shard) and dp/cp (local token count)."""
+    if fused is None:
+        tokens = math.prod(hidden.shape[:-1])
+        fused = tokens * weight.shape[0] * 4 > FUSED_CE_AUTO_BYTES
     if fused:
         return vocab_parallel_cross_entropy_from_hidden(
             hidden, weight, targets,

@@ -279,8 +279,22 @@ def child_gpt(platform: str):
     # headline/variant tokens-per-sec, so >1 means the lever helps.
     ab = {}
     if on_tpu:
+        # the default is fused_ce=None (auto by logits size, PROFILE_r05)
+        # — the headline already runs whatever auto picks at best_batch,
+        # so the informative variant is the FORCED OPPOSITE of that
+        # choice.  New key name (fused_ce_auto_speedup) because the old
+        # fused_ce_speedup trended the inverse lever (forced-off vs a
+        # forced-fused headline); > 1 means auto beat the opposite path.
+        from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+            FUSED_CE_AUTO_BYTES,
+        )
+
+        auto_fused = (
+            best_batch * SEQ * cfg_common["vocab_size"] * 4
+            > FUSED_CE_AUTO_BYTES
+        )
         for tag, over in (
-            ("fused_ce", {"fused_ce": False}),
+            ("fused_ce_auto", {"fused_ce": not auto_fused}),
             ("remat", {"remat": False}),
         ):
             try:

@@ -503,3 +503,26 @@ class TestFp32DispatchWindow:
         q = jnp.ones((1, 1, s, 8), jnp.float32)
         attn_mod.flash_attention(q, q, q, implementation=None)
         assert len(calls) == 1  # beyond the window: pallas
+
+
+class TestFp32BlockClamp:
+    """fp32 blocks are clamped to the 512*1024 area before the kernel is
+    built: the bwd kernels hold ~4 (block_q, block_k) fp32 temporaries
+    live, and 1024x1024 fp32 blocks measured 18.3 MB of scoped vmem
+    against Mosaic's 16 MB stack limit (r5 sweep compile failure)."""
+
+    def test_fp32_oversize_blocks_clamped(self):
+        from apex_tpu.ops.attention import _clamp_blocks
+
+        assert _clamp_blocks(jnp.float32, 1024, 1024) == (512, 1024)
+        assert _clamp_blocks(jnp.float32, 2048, 1024) == (512, 1024)
+        assert _clamp_blocks(jnp.float32, 512, 2048) == (512, 1024)
+        # at or under the area: untouched
+        assert _clamp_blocks(jnp.float32, 512, 1024) == (512, 1024)
+        assert _clamp_blocks(jnp.float32, 256, 512) == (256, 512)
+
+    def test_bf16_blocks_untouched(self):
+        from apex_tpu.ops.attention import _clamp_blocks
+
+        assert _clamp_blocks(jnp.bfloat16, 1024, 1024) == (1024, 1024)
+        assert _clamp_blocks(jnp.bfloat16, 2048, 2048) == (2048, 2048)

@@ -417,3 +417,57 @@ def test_ce_smoothing_matches_contrib_xentropy():
             )
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def test_lm_head_ce_auto_dispatch(monkeypatch):
+    """fused=None (the GPT/BERT/T5 default) routes by the materialized-
+    logits residual size: <= FUSED_CE_AUTO_BYTES takes the two-step path
+    (measured faster on v5e, PROFILE_r05), above it the fused scan
+    (memory-bounded).  The boundary is strict-greater: exactly-at-the-
+    threshold stays two-step."""
+    from apex_tpu.transformer.tensor_parallel import cross_entropy as ce
+
+    calls = []
+    real_fused = ce.vocab_parallel_cross_entropy_from_hidden
+    real_two = ce.vocab_parallel_cross_entropy
+    monkeypatch.setattr(
+        ce, "vocab_parallel_cross_entropy_from_hidden",
+        lambda *a, **k: (calls.append("fused"), real_fused(*a, **k))[1],
+    )
+    monkeypatch.setattr(
+        ce, "vocab_parallel_cross_entropy",
+        lambda *a, **k: (calls.append("two_step"), real_two(*a, **k))[1],
+    )
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=4
+    )
+    try:
+        n, h, vocab = 16, 32, 64
+        x = jax.random.normal(jax.random.PRNGKey(0), (n, h), jnp.float32)
+        w = 0.5 * jax.random.normal(
+            jax.random.PRNGKey(1), (vocab, h), jnp.float32
+        )
+        t = jax.random.randint(jax.random.PRNGKey(2), (n,), 0, vocab)
+
+        def run(x, w, t):
+            return jnp.mean(ce.lm_head_cross_entropy(x, w, t, chunk=8))
+
+        def call():
+            return jax.jit(jax.shard_map(
+                run, mesh=mesh,
+                in_specs=(P(), P("tp", None), P()), out_specs=P(),
+            ))(x, w, t)
+
+        # local shard bytes: n * (vocab/tp) * 4 = 16 * 16 * 4 = 1024
+        monkeypatch.setattr(ce, "FUSED_CE_AUTO_BYTES", 1024)
+        call()  # == threshold: strict >, stays two-step
+        assert calls == ["two_step"]
+        monkeypatch.setattr(ce, "FUSED_CE_AUTO_BYTES", 1023)
+        call()
+        assert calls == ["two_step", "fused"]
+        monkeypatch.setattr(ce, "FUSED_CE_AUTO_BYTES", 1 << 31)
+        call()  # production threshold: tiny logits -> two-step
+        assert calls == ["two_step", "fused", "two_step"]
+    finally:
+        parallel_state.destroy_model_parallel()
