@@ -889,6 +889,12 @@ def flash_attention(
     )
 
 
+# fp32 block-area cap shared with the kernel-validation sweep (which
+# must skip configs the wrapper would clamp, or it double-times the
+# clamped program under multiple labels)
+FLASH_FP32_MAX_BLOCK_AREA = 512 * 1024
+
+
 def _clamp_blocks(dtype, block_q: int, block_k: int):
     """Clamp the (block_q, block_k) area for fp32 inputs.
 
@@ -904,7 +910,7 @@ def _clamp_blocks(dtype, block_q: int, block_k: int):
     1024x1024 compiling and winning there (KERNELS_TPU.json).
     """
     if dtype == jnp.float32:
-        while block_q * block_k > 512 * 1024:
+        while block_q * block_k > FLASH_FP32_MAX_BLOCK_AREA:
             if block_q >= block_k:
                 block_q //= 2
             else:

@@ -48,6 +48,14 @@ FUSED_CE_AUTO_BYTES = int(
 )
 
 
+def fused_ce_auto(tokens_local: int, vocab_local: int) -> bool:
+    """The ``fused=None`` decision rule, exported so measurement
+    harnesses predict the dispatcher's choice from the SAME arithmetic
+    (shard_map-local token and vocab-shard counts) instead of
+    re-deriving it from global shapes and drifting."""
+    return tokens_local * vocab_local * 4 > FUSED_CE_AUTO_BYTES
+
+
 def _largest_chunk_divisor(v_local: int, chunk: int) -> int:
     """Largest divisor of ``v_local`` that is <= ``chunk`` — the fused
     CE walks equal weight slices, and common vocab shards (32000/tp)
@@ -83,8 +91,7 @@ def lm_head_cross_entropy(
     shapes here are the shard_map-local shard, so the rule composes
     with tp (vocab/tp local shard) and dp/cp (local token count)."""
     if fused is None:
-        tokens = math.prod(hidden.shape[:-1])
-        fused = tokens * weight.shape[0] * 4 > FUSED_CE_AUTO_BYTES
+        fused = fused_ce_auto(math.prod(hidden.shape[:-1]), weight.shape[0])
     if fused:
         return vocab_parallel_cross_entropy_from_hidden(
             hidden, weight, targets,

@@ -285,13 +285,17 @@ def child_gpt(platform: str):
         # choice.  New key name (fused_ce_auto_speedup) because the old
         # fused_ce_speedup trended the inverse lever (forced-off vs a
         # forced-fused headline); > 1 means auto beat the opposite path.
+        # The prediction uses the dispatcher's own exported rule on the
+        # shard_map-LOCAL sizes (tokens/dp, vocab/tp) — global shapes
+        # would mispredict on any multi-device mesh.
         from apex_tpu.transformer.tensor_parallel.cross_entropy import (
-            FUSED_CE_AUTO_BYTES,
+            fused_ce_auto,
         )
 
-        auto_fused = (
-            best_batch * SEQ * cfg_common["vocab_size"] * 4
-            > FUSED_CE_AUTO_BYTES
+        mesh = parallel_state.get_mesh()
+        auto_fused = fused_ce_auto(
+            best_batch // mesh.shape["dp"] * SEQ,
+            cfg_common["vocab_size"] // mesh.shape["tp"],
         )
         for tag, over in (
             ("fused_ce_auto", {"fused_ce": not auto_fused}),

@@ -82,6 +82,7 @@ def _max_err(a, b):
 
 def validate_flash(smoke=False):
     from apex_tpu.ops.attention import (
+        FLASH_FP32_MAX_BLOCK_AREA,
         FLASH_FP32_XLA_MAX_SEQ,
         flash_attention,
         mha_reference,
@@ -163,7 +164,7 @@ def validate_flash(smoke=False):
                 # timing those configs would silently duplicate the
                 # clamped program and could report a best_block that
                 # never ran
-                if dtype == jnp.float32 and bq * bk > 512 * 1024:
+                if dtype == jnp.float32 and bq * bk > FLASH_FP32_MAX_BLOCK_AREA:
                     sweep[f"{bq}x{bk}"] = "clamped (fp32 vmem limit)"
                     continue
                 try:

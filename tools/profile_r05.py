@@ -83,9 +83,13 @@ def build(**cfg_over):
             # as the full step's optimizer
             grads = jax.tree.map(lambda p: p * 0, params)
         if no_opt:
-            # fwd+bwd without the optimizer: fold grads into the loss
+            # fwd+bwd without the optimizer: fold grads into the loss.
+            # tp-sharded grad leaves make the bare sum tp-varying, which
+            # out_specs P() rejects — pmean it back to replicated (it is
+            # zero anyway; only the data dependency matters)
             gsum = sum(jnp.sum(g.astype(jnp.float32) * 0)
                        for g in jax.tree.leaves(grads))
+            gsum = jax.lax.pmean(gsum, "tp")
             return params, opt_state, loss + gsum
         new_params, new_opt = opt.step(opt_state, grads, params)
         return new_params, new_opt, loss

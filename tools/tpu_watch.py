@@ -187,20 +187,21 @@ def main():
                     json.dump({"captured": captured, "attempt": attempt,
                                "bench_rc": rc, "result": line}, f, indent=1)
                 if captured:
-                    log("bench captured on TPU; running kernel sweep")
-                    rc2, out2, err2 = run(
-                        [PY, os.path.join(REPO, "tools",
-                                          "kernel_validation.py")],
-                        2400, grace=90)
-                    log(f"kernel sweep rc={rc2}")
-                    sys.stderr.write((err2 or "")[-2000:])
-                    log("running PROFILE_r05 decomposition")
-                    rc3, out3, err3 = run(
-                        [PY, os.path.join(REPO, "tools",
-                                          "profile_r05.py")],
-                        2400, grace=90)
-                    log(f"profile rc={rc3}")
-                    sys.stderr.write((err3 or "")[-2000:])
+                    # ordered by information value per chip-minute: the
+                    # scale sweep (new artifact) and profile (refreshes
+                    # the decomposition at the current default) before
+                    # the kernel sweep (usually already fresh)
+                    for label, tool, budget in (
+                        ("scale_mfu", "scale_mfu.py", 2400),
+                        ("profile", "profile_r05.py", 2400),
+                        ("kernel sweep", "kernel_validation.py", 2400),
+                    ):
+                        log(f"running {label}")
+                        rc2, out2, err2 = run(
+                            [PY, os.path.join(REPO, "tools", tool)],
+                            budget, grace=90)
+                        log(f"{label} rc={rc2}")
+                        sys.stderr.write((err2 or "")[-2000:])
                     return 0
                 log(f"bench ran but no TPU result (rc={rc}); continuing")
             else:
