@@ -66,6 +66,14 @@ class GPTConfig:
     hidden_size: int = 512
     num_attention_heads: int = 8
     max_position_embeddings: int = 1024
+    # "learned" = trained absolute-position table (the reference GPT's
+    # scheme, standalone_gpt.py); "rope" = rotary embeddings applied to
+    # (q, k) in every layer (ops/rope.py — the fork's mentioned-but-
+    # absent rope capability, SURVEY.md §2.1).  rope models carry no
+    # position table, so max_position_embeddings only bounds nothing —
+    # any sequence length runs.
+    position_embedding: str = "learned"
+    rope_base: float = 10000.0
     ffn_hidden_size: Optional[int] = None  # defaults to 4*hidden
     hidden_dropout: float = 0.0
     attention_dropout: float = 0.0
@@ -122,6 +130,13 @@ class GPTConfig:
                 "attention_dropout is not supported with context_parallel "
                 "(the explicit-softmax dropout path is not ring-aware)"
             )
+        if self.position_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"position_embedding must be 'learned' or 'rope', got "
+                f"{self.position_embedding!r}"
+            )
+        if self.position_embedding == "rope" and self.head_dim % 2:
+            raise ValueError("rope needs an even head_dim")
 
     @property
     def head_dim(self) -> int:
@@ -240,17 +255,20 @@ class GPTModel:
         layer_keys = jax.random.split(k_layers, c.num_layers)
         # stacked layer params: every leaf gets a leading num_layers dim
         layers = jax.vmap(self._init_one_layer)(layer_keys)
-        return {
+        params = {
             "embedding": self.embedding.init(k_emb),
-            "pos_embedding": _normal(c.init_method_std)(
-                k_pos, (c.max_position_embeddings, c.hidden_size), c.params_dtype
-            ),
             "layers": layers,
             "final_ln": {
                 "scale": jnp.ones((c.hidden_size,), c.norm_dtype),
                 "bias": jnp.zeros((c.hidden_size,), c.norm_dtype),
             },
         }
+        if c.position_embedding == "learned":
+            params["pos_embedding"] = _normal(c.init_method_std)(
+                k_pos, (c.max_position_embeddings, c.hidden_size),
+                c.params_dtype,
+            )
+        return params
 
     def param_specs(self) -> Dict[str, Any]:
         rep = {"scale": P(), "bias": P()}
@@ -269,17 +287,22 @@ class GPTModel:
         stacked = jax.tree.map(
             lambda s: P(None, *s), layer, is_leaf=lambda x: isinstance(x, P)
         )
-        return {
+        specs = {
             "embedding": self.embedding.param_specs(),
-            "pos_embedding": P(),
             "layers": stacked,
             "final_ln": dict(rep),
         }
+        if self.config.position_embedding == "learned":
+            specs["pos_embedding"] = P()
+        return specs
 
     # ------------------------------------------------------------- forward
-    def _layer(self, lp: Dict[str, Any], x: jnp.ndarray, key) -> jnp.ndarray:
+    def _layer(self, lp: Dict[str, Any], x: jnp.ndarray, key,
+               rope=None) -> jnp.ndarray:
         """One transformer layer on the local shard. x: (b, s, h) replicated
-        over tp; lp: this layer's param shards."""
+        over tp; lp: this layer's param shards; ``rope``: precomputed
+        (cos, sin) tables from :meth:`_rope_tables` (None for learned
+        positions)."""
         c = self.config
         world = jax.lax.axis_size(self.axis_name)
         heads_local = c.num_attention_heads // world
@@ -300,6 +323,11 @@ class GPTModel:
         q, k, v = (
             jnp.moveaxis(qkv[:, :, :, i], 2, 1) for i in range(3)
         )  # each (b, heads_local, s, d)
+        if rope is not None:
+            from apex_tpu.ops.rope import apply_rope_tables
+
+            q = apply_rope_tables(q, *rope)
+            k = apply_rope_tables(k, *rope)
         if c.attention_dropout > 0.0 and key is not None:
             # Megatron semantics: dropout on the softmax *probabilities*
             # (reference: standalone_gpt.py attention_probs dropout), kept
@@ -353,18 +381,53 @@ class GPTModel:
             y = jnp.where(keep, y / (1.0 - c.hidden_dropout), 0.0)
         return residual + y.astype(residual.dtype), aux
 
-    def _pos_slice(self, params: Dict[str, Any], s: int) -> jnp.ndarray:
-        """Local slice of the position table: under context parallelism
-        the (b, s) tokens are the cp-rank's sequence chunk, so positions
-        start at ``cp_rank * s``."""
+    def _embed(self, params: Dict[str, Any], tokens: jnp.ndarray):
+        """Token embedding + (learned-table) position add, in compute
+        dtype — the one entry shared by the sequential and both pipeline
+        paths so the position_embedding mode can't diverge between them.
+        rope models add nothing here; their rotation happens on (q, k)
+        inside every layer (:meth:`_layer`)."""
+        c = self.config
+        x = self.embedding.apply(params["embedding"], tokens)
+        if c.position_embedding == "learned":
+            s = tokens.shape[1]
+            x = x + self._pos_slice(params, s)[None, :, :].astype(x.dtype)
+        return x.astype(c.compute_dtype)
+
+    def _chunk_offset(self, s: int):
+        """Global start position of the local (b, s) sequence chunk —
+        cp_rank * s under context parallelism, 0 otherwise.  The ONE
+        definition of the cp chunking contract, shared by the learned
+        table (:meth:`_pos_slice`) and rope (:meth:`_rope_tables`) so
+        the two position modes can never disagree about where a chunk
+        sits."""
         if self.config.context_parallel:
             from apex_tpu.transformer.parallel_state import (
                 CONTEXT_PARALLEL_AXIS,
             )
 
-            offset = jax.lax.axis_index(CONTEXT_PARALLEL_AXIS) * s
+            return jax.lax.axis_index(CONTEXT_PARALLEL_AXIS) * s
+        return 0
+
+    def _rope_tables(self, s: int):
+        """(cos, sin) rotation tables for the local chunk's GLOBAL
+        positions, computed ONCE per forward — the layer scan closes
+        over them (a scan body cannot hoist the iota+trig, so computing
+        inside :meth:`_layer` would redo it num_layers times and again
+        in the remat backward)."""
+        from apex_tpu.ops.rope import rope_cos_sin
+
+        positions = self._chunk_offset(s) + jnp.arange(s, dtype=jnp.int32)
+        return rope_cos_sin(positions, self.config.head_dim,
+                            self.config.rope_base)
+
+    def _pos_slice(self, params: Dict[str, Any], s: int) -> jnp.ndarray:
+        """Local slice of the position table: under context parallelism
+        the (b, s) tokens are the cp-rank's sequence chunk, so positions
+        start at ``cp_rank * s``."""
+        if self.config.context_parallel:
             return jax.lax.dynamic_slice_in_dim(
-                params["pos_embedding"], offset, s, axis=0
+                params["pos_embedding"], self._chunk_offset(s), s, axis=0
             )
         return params["pos_embedding"][:s]
 
@@ -379,16 +442,16 @@ class GPTModel:
         summed MoE aux loss — 0.0 for dense models)."""
         c = self.config
         b, s = tokens.shape
-        x = self.embedding.apply(params["embedding"], tokens)
-        pos = self._pos_slice(params, s)
-        x = x + pos[None, :, :].astype(x.dtype)
-        x = x.astype(c.compute_dtype)
+        x = self._embed(params, tokens)
 
         use_rng = rng is not None
+        rope = (self._rope_tables(s)
+                if c.position_embedding == "rope" else None)
 
         def body(carry, scanned):
             lp, key = scanned
-            out, aux = self._layer(lp, carry, key if use_rng else None)
+            out, aux = self._layer(lp, carry, key if use_rng else None,
+                                   rope=rope)
             return out, aux
 
         if c.remat:
@@ -528,8 +591,13 @@ class GPTModel:
         accumulator rides the ppermute ring with its microbatch), plain
         hidden otherwise."""
 
+        c = self.config
+        s = (x["h"] if self.moe is not None else x).shape[1]
+        rope = (self._rope_tables(s)
+                if c.position_embedding == "rope" else None)
+
         def body(h, lp):
-            out, aux = self._layer(lp, h, None)
+            out, aux = self._layer(lp, h, None, rope=rope)
             return out, aux
 
         if self.moe is not None:
@@ -569,9 +637,7 @@ class GPTModel:
         moe = self.moe is not None
 
         def first_fn(m):
-            x = self.embedding.apply(params["embedding"], m["tokens"])
-            x = x + self._pos_slice(params, s)[None, :, :].astype(x.dtype)
-            x = x.astype(c.compute_dtype)
+            x = self._embed(params, m["tokens"])
             # MoE: the activation stream carries a per-microbatch aux
             # accumulator (schedules are pytree-generic, so the scalar
             # rides the ppermute ring with its microbatch for free).
@@ -661,9 +727,7 @@ class GPTModel:
         moe = self.moe is not None
 
         def first_fn(prm, m):
-            x = self.embedding.apply(prm["embedding"], m["tokens"])
-            x = x + self._pos_slice(prm, s)[None, :, :].astype(x.dtype)
-            x = x.astype(c.compute_dtype)
+            x = self._embed(prm, m["tokens"])
             # MoE: per-microbatch aux accumulator rides the stream; the
             # zero derives from x to carry its varying-mesh-axes type
             # (see pipeline_loss)

@@ -1,0 +1,79 @@
+"""Rotary position embeddings (RoPE), fused by XLA.
+
+Closes the reference fork's mentioned-but-absent rope capability
+(reference: SURVEY.md §2.1 "transformer.layers (fused RoPE note)" — the
+fork's BASELINE mentions rope, but csrc/megatron ships only softmax
+kernels).  TPU design note: RoPE is a pure elementwise rotation of the
+(q, k) projections, so the right "fused kernel" on TPU is none at all —
+XLA fuses the rotate into the projection epilogue / attention prologue,
+and a hand-written Pallas kernel could only add launch overhead (same
+decision record as layer norm / softmax, docs/kernels.md).
+
+Convention: half-split rotate (Llama/NeoX style) — the head dim is
+split into two halves forming (x1, x2) pairs rotated by
+position-dependent angles; frequencies follow the original RoPE
+geometric ladder ``base**(-2i/d)``.  Trig runs in fp32 regardless of
+the activation dtype (bf16 angles visibly drift past ~2k positions),
+and the rotation is applied in fp32 then cast back.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+__all__ = ["rope_cos_sin", "apply_rope", "apply_rope_tables"]
+
+
+def rope_cos_sin(
+    positions: jnp.ndarray, head_dim: int, base: float = 10000.0
+):
+    """(cos, sin) tables for ``positions`` (any shape, int), each of
+    shape ``positions.shape + (head_dim // 2,)``, fp32."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    half = head_dim // 2
+    inv_freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    positions: Optional[jnp.ndarray] = None,
+    *,
+    base: float = 10000.0,
+    position_offset: int = 0,
+) -> jnp.ndarray:
+    """Rotate ``x`` of shape (..., seq, head_dim) by its positions.
+
+    ``positions`` defaults to ``offset + arange(seq)`` —
+    ``position_offset`` is the context-parallel hook: cp rank r passes
+    ``r * local_seq`` so its sequence chunk is rotated by GLOBAL
+    positions (the same contract as the learned table's ``_pos_slice``,
+    models/gpt.py).  Output dtype matches the input.
+    """
+    seq, d = x.shape[-2], x.shape[-1]
+    if positions is None:
+        positions = position_offset + jnp.arange(seq, dtype=jnp.int32)
+    cos, sin = rope_cos_sin(positions, d, base)  # (seq, d/2) fp32
+    return apply_rope_tables(x, cos, sin)
+
+
+def apply_rope_tables(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate by PRECOMPUTED (cos, sin) tables of shape (seq, d/2).
+
+    Separate entry so callers scanning over layers (models/gpt.py) can
+    compute the trig once and close over the tables — a scan body can't
+    hoist the iota+trig itself, so the fused form would re-run it every
+    layer and again in the remat backward."""
+    d = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
