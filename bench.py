@@ -292,10 +292,15 @@ def child_gpt(platform: str):
             fused_ce_auto,
         )
 
-        mesh = parallel_state.get_mesh()
+        try:
+            mesh = parallel_state.get_mesh()
+            dp, tp = mesh.shape["dp"], mesh.shape["tp"]
+        except Exception:
+            # headline already captured — a surprise here must degrade
+            # to the single-chip arithmetic, not lose the whole child
+            dp = tp = 1
         auto_fused = fused_ce_auto(
-            best_batch // mesh.shape["dp"] * SEQ,
-            cfg_common["vocab_size"] // mesh.shape["tp"],
+            best_batch // dp * SEQ, cfg_common["vocab_size"] // tp
         )
         for tag, over in (
             ("fused_ce_auto", {"fused_ce": not auto_fused}),
