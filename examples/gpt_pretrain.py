@@ -71,6 +71,10 @@ def main(argv=None):
                          "(DistributedFusedAdam)")
     ap.add_argument("--num-experts", type=int, default=None,
                     help="Switch-MoE experts riding dp as the ep axis")
+    ap.add_argument("--position-embedding", default="learned",
+                    choices=["learned", "rope"],
+                    help="rope = rotary (q, k) rotation, no position "
+                         "table; any sequence length runs")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--save-every", type=int, default=25)
     args = ap.parse_args(argv)
@@ -85,6 +89,7 @@ def main(argv=None):
         vocab_size=args.vocab, num_layers=args.layers,
         hidden_size=args.hidden, num_attention_heads=args.heads,
         max_position_embeddings=args.seq, policy=mp.policy,
+        position_embedding=args.position_embedding,
         num_experts=args.num_experts,
         moe_capacity_factor=2.0,  # read only when num_experts is set
     )
