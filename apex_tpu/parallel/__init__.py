@@ -15,6 +15,7 @@ TPU-native replacement for the reference's NCCL data-parallel layer
 
 from apex_tpu.parallel.distributed import (  # noqa: F401
     DistributedDataParallel,
+    Reducer,
     all_reduce_gradients,
     data_parallel_mesh,
     hierarchical_data_parallel_mesh,
@@ -31,6 +32,7 @@ from apex_tpu.optimizers.larc import LARC  # noqa: F401
 
 __all__ = [
     "DistributedDataParallel",
+    "Reducer",
     "all_reduce_gradients",
     "data_parallel_mesh",
     "hierarchical_data_parallel_mesh",
