@@ -36,7 +36,10 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.ops.attention import flash_attention
-from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+from apex_tpu.ops.layer_norm import (
+    fused_layer_norm_affine,
+    fused_rms_norm_affine,
+)
 from apex_tpu.transformer.parallel_state import (
     DATA_PARALLEL_AXIS,
     TENSOR_PARALLEL_AXIS,
@@ -74,6 +77,12 @@ class GPTConfig:
     # any sequence length runs.
     position_embedding: str = "learned"
     rope_base: float = 10000.0
+    # "gelu" (reference GPT) or "swiglu" (gated SiLU MLP); with
+    # position_embedding="rope" and normalization="rmsnorm" the same
+    # model expresses the modern Llama-style decoder family
+    activation: str = "gelu"
+    # "layernorm" (scale+bias, reference) or "rmsnorm" (scale only)
+    normalization: str = "layernorm"
     ffn_hidden_size: Optional[int] = None  # defaults to 4*hidden
     hidden_dropout: float = 0.0
     attention_dropout: float = 0.0
@@ -137,6 +146,19 @@ class GPTConfig:
             )
         if self.position_embedding == "rope" and self.head_dim % 2:
             raise ValueError("rope needs an even head_dim")
+        if self.activation not in ("gelu", "swiglu"):
+            raise ValueError(
+                f"activation must be 'gelu' or 'swiglu', got "
+                f"{self.activation!r}"
+            )
+        if self.normalization not in ("layernorm", "rmsnorm"):
+            raise ValueError(
+                f"normalization must be 'layernorm' or 'rmsnorm', got "
+                f"{self.normalization!r}"
+            )
+        if self.activation == "swiglu" and self.num_experts is not None:
+            raise ValueError("swiglu is the dense-MLP path; MoE experts "
+                             "keep their own activation")
 
     @property
     def head_dim(self) -> int:
@@ -204,6 +226,22 @@ class GPTModel:
             params_dtype=c.params_dtype,
             axis_name=axis_name,
         )
+        self.fc_gate = None
+        if c.activation == "swiglu":
+            # TWO column-parallel projections, not one 2x-wide fused
+            # weight: a tp shard of a fused [gate | up] layout would be
+            # all-gate on low ranks (the contiguous-slice hazard the
+            # fused qkv avoids by per-head grouping); separate weights
+            # are correct at any tp and XLA fuses the twin GEMMs on the
+            # shared input anyway
+            self.fc_gate = ColumnParallelLinear(
+                c.hidden_size,
+                c.ffn_hidden_size,
+                gather_output=False,
+                init_method=init,
+                params_dtype=c.params_dtype,
+                axis_name=axis_name,
+            )
         self.fc2 = RowParallelLinear(
             c.ffn_hidden_size,
             c.hidden_size,
@@ -230,12 +268,9 @@ class GPTModel:
 
     # ---------------------------------------------------------------- init
     def _init_one_layer(self, key) -> Dict[str, Any]:
-        keys = jax.random.split(key, 4)
+        keys = jax.random.split(key, 5)
         c = self.config
-        ln = lambda: {
-            "scale": jnp.ones((c.hidden_size,), c.norm_dtype),
-            "bias": jnp.zeros((c.hidden_size,), c.norm_dtype),
-        }
+        ln = self._norm_init
         layer = {
             "ln1": ln(),
             "qkv": self.qkv.init(keys[0]),
@@ -247,7 +282,30 @@ class GPTModel:
         else:
             layer["fc1"] = self.fc1.init(keys[2])
             layer["fc2"] = self.fc2.init(keys[3])
+            if self.fc_gate is not None:
+                layer["fc_gate"] = self.fc_gate.init(keys[4])
         return layer
+
+    def _norm_init(self) -> Dict[str, Any]:
+        c = self.config
+        p = {"scale": jnp.ones((c.hidden_size,), c.norm_dtype)}
+        if c.normalization == "layernorm":
+            p["bias"] = jnp.zeros((c.hidden_size,), c.norm_dtype)
+        return p
+
+    def _norm(self, p: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+        """ln1/ln2/final_ln dispatch: fused layer norm (scale+bias) or
+        RMSNorm (scale only) per ``config.normalization``; fp32 math
+        either way (the norm-in-fp32 contract of the amp policies)."""
+        c = self.config
+        if c.normalization == "rmsnorm":
+            return fused_rms_norm_affine(
+                x, p["scale"], (c.hidden_size,), eps=c.layernorm_epsilon
+            )
+        return fused_layer_norm_affine(
+            x, p["scale"], p["bias"], (c.hidden_size,),
+            eps=c.layernorm_epsilon,
+        )
 
     def init(self, key) -> Dict[str, Any]:
         c = self.config
@@ -258,10 +316,7 @@ class GPTModel:
         params = {
             "embedding": self.embedding.init(k_emb),
             "layers": layers,
-            "final_ln": {
-                "scale": jnp.ones((c.hidden_size,), c.norm_dtype),
-                "bias": jnp.zeros((c.hidden_size,), c.norm_dtype),
-            },
+            "final_ln": self._norm_init(),
         }
         if c.position_embedding == "learned":
             params["pos_embedding"] = _normal(c.init_method_std)(
@@ -271,7 +326,9 @@ class GPTModel:
         return params
 
     def param_specs(self) -> Dict[str, Any]:
-        rep = {"scale": P(), "bias": P()}
+        rep = {"scale": P()}
+        if self.config.normalization == "layernorm":
+            rep["bias"] = P()
         layer = {
             "ln1": rep,
             "qkv": self.qkv.param_specs(),
@@ -283,6 +340,8 @@ class GPTModel:
         else:
             layer["fc1"] = self.fc1.param_specs()
             layer["fc2"] = self.fc2.param_specs()
+            if self.fc_gate is not None:
+                layer["fc_gate"] = self.fc_gate.param_specs()
         # prepend the stacked-layer dim (replicated) to each layer spec
         stacked = jax.tree.map(
             lambda s: P(None, *s), layer, is_leaf=lambda x: isinstance(x, P)
@@ -310,9 +369,7 @@ class GPTModel:
 
         # -- attention block ------------------------------------------
         residual = x
-        y = fused_layer_norm_affine(
-            x, lp["ln1"]["scale"], lp["ln1"]["bias"], (h,), eps=c.layernorm_epsilon
-        ).astype(c.compute_dtype)
+        y = self._norm(lp["ln1"], x).astype(c.compute_dtype)
         # output dim of the fused qkv weight is grouped per head —
         # [h0_q h0_k h0_v h1_q …] — so a contiguous tp slice holds whole
         # (q,k,v) triplets and the math is identical for every tp size
@@ -365,14 +422,18 @@ class GPTModel:
 
         # -- MLP block (dense or expert-parallel MoE) -------------------
         residual = x
-        y = fused_layer_norm_affine(
-            x, lp["ln2"]["scale"], lp["ln2"]["bias"], (h,), eps=c.layernorm_epsilon
-        ).astype(c.compute_dtype)
+        y = self._norm(lp["ln2"], x).astype(c.compute_dtype)
         if self.moe is not None:
             y, aux = self.moe.apply(lp["moe"], y)
         else:
-            y = self.fc1.apply(lp["fc1"], y)
-            y = jax.nn.gelu(y, approximate=True)
+            if self.fc_gate is not None:
+                # SwiGLU: silu(gate(x)) * up(x) — both column-parallel
+                # on the same input, elementwise gate on the local shard
+                y = (jax.nn.silu(self.fc_gate.apply(lp["fc_gate"], y))
+                     * self.fc1.apply(lp["fc1"], y))
+            else:
+                y = self.fc1.apply(lp["fc1"], y)
+                y = jax.nn.gelu(y, approximate=True)
             y = self.fc2.apply(lp["fc2"], y)
             aux = jnp.float32(0.0)
         if c.hidden_dropout > 0.0 and key is not None:
@@ -467,13 +528,7 @@ class GPTModel:
         )
         x, aux = jax.lax.scan(body, x, (params["layers"], keys))
 
-        x = fused_layer_norm_affine(
-            x.astype(jnp.float32),
-            params["final_ln"]["scale"],
-            params["final_ln"]["bias"],
-            (c.hidden_size,),
-            eps=c.layernorm_epsilon,
-        )
+        x = self._norm(params["final_ln"], x.astype(jnp.float32))
         return x.astype(c.compute_dtype), jnp.sum(aux)
 
     def logits(self, params: Dict[str, Any], hidden: jnp.ndarray) -> jnp.ndarray:
@@ -652,13 +707,7 @@ class GPTModel:
 
         def last_fn(x, m):
             x, aux = (x["h"], x["aux"]) if moe else (x, None)
-            x = fused_layer_norm_affine(
-                x.astype(jnp.float32),
-                params["final_ln"]["scale"],
-                params["final_ln"]["bias"],
-                (c.hidden_size,),
-                eps=c.layernorm_epsilon,
-            ).astype(c.compute_dtype)
+            x = self._norm(params["final_ln"], x.astype(jnp.float32)).astype(c.compute_dtype)
             per_token = self._per_token_ce(params, x, m["targets"])
             loss = jnp.mean(per_token)
             if moe:
@@ -747,13 +796,7 @@ class GPTModel:
 
         def last_fn(prm, x, m):
             x, aux = (x["h"], x["aux"]) if moe else (x, None)
-            x = fused_layer_norm_affine(
-                x.astype(jnp.float32),
-                prm["final_ln"]["scale"],
-                prm["final_ln"]["bias"],
-                (c.hidden_size,),
-                eps=c.layernorm_epsilon,
-            ).astype(c.compute_dtype)
+            x = self._norm(prm["final_ln"], x.astype(jnp.float32)).astype(c.compute_dtype)
             per_token = self._per_token_ce(prm, x, m["targets"])
             loss = jnp.mean(per_token)
             if moe:
