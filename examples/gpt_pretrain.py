@@ -75,6 +75,10 @@ def main(argv=None):
                     choices=["learned", "rope"],
                     help="rope = rotary (q, k) rotation, no position "
                          "table; any sequence length runs")
+    ap.add_argument("--activation", default="gelu",
+                    choices=["gelu", "swiglu"])
+    ap.add_argument("--normalization", default="layernorm",
+                    choices=["layernorm", "rmsnorm"])
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--save-every", type=int, default=25)
     args = ap.parse_args(argv)
@@ -90,6 +94,8 @@ def main(argv=None):
         hidden_size=args.hidden, num_attention_heads=args.heads,
         max_position_embeddings=args.seq, policy=mp.policy,
         position_embedding=args.position_embedding,
+        activation=args.activation,
+        normalization=args.normalization,
         num_experts=args.num_experts,
         moe_capacity_factor=2.0,  # read only when num_experts is set
     )
