@@ -28,8 +28,9 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
-# Long-running tests (measured: tests/run_tests.sh keeps `-m l0` under
-# 300 s on a 1-core host; full-suite --durations picked these).  Whole
+# Long-running tests (measured: tests/run_tests.sh keeps `-m l0` around
+# 7 min for 283 tests on a 1-core host, r5; full-suite --durations
+# picked these).  Whole
 # modules are marked in-file (test_cross_product — the L1-style tier —
 # test_combined_axes); individual heavyweights live here so the split
 # stays visible in one place.

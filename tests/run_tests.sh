@@ -4,7 +4,7 @@
 # and the .jenkins CI harness:
 #
 #   tests/run_tests.sh l0       fast gate: every subsystem smoke-covered,
-#                               < 300 s on a 1-core host
+#                               ~7 min on a 1-core host (283 tests, r5)
 #   tests/run_tests.sh full     the whole suite, chunked so no single
 #                               pytest invocation exceeds a CI timeout
 #   tests/run_tests.sh strict   l0 with APEX_TPU_STRICT_KERNELS=1 — any
