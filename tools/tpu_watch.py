@@ -75,12 +75,17 @@ def _sigterm(signum, frame):
     _sys.exit(143)
 
 
-def run(args, timeout, grace=60):
+def run(args, timeout, grace=60, env_over=None):
     """SIGTERM-first bounded subprocess (never immediate SIGKILL: a hard
     kill of a client holding the chip claim is what wedges the pool)."""
     global _current_proc
+    env = None
+    if env_over:
+        env = dict(os.environ)
+        env.update(env_over)
     proc = subprocess.Popen(args, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True, cwd=REPO)
+                            stderr=subprocess.PIPE, text=True, cwd=REPO,
+                            env=env)
     _current_proc = proc
     try:
         out, err = proc.communicate(timeout=timeout)
@@ -170,9 +175,14 @@ def main():
                 log(f"chip contact on attempt {attempt} ({plat}); "
                     "running full bench")
                 # Full pipeline: probe+gpt+extras, persists
-                # LAST_TPU_BENCH.json on TPU success.
-                rc, out, err = run([PY, os.path.join(REPO, "bench.py")],
-                                   3600, grace=90)
+                # LAST_TPU_BENCH.json on TPU success.  The watcher is
+                # not gate-constrained, so give the children room: the
+                # r5 round-start extras child hit its default 1200 s
+                # budget mid-section and lost the long-seq + t5 rows.
+                rc, out, err = run(
+                    [PY, os.path.join(REPO, "bench.py")], 4500, grace=90,
+                    env_over={"APEX_BENCH_TOTAL_BUDGET": "4200",
+                              "APEX_BENCH_CHILD_TIMEOUT": "1800"})
                 sys.stderr.write((err or "")[-3000:])
                 line = None
                 for ln in reversed((out or "").strip().splitlines()):
