@@ -215,8 +215,18 @@ def main():
         "DCE'd). All variants one process, host-readback timing "
         "(axon tunnel rules).",
     ]
-    with open(os.path.join(REPO, "PROFILE_r05.md"), "w") as f:
-        f.write("\n".join(lines) + "\n")
+    # the hand-written roofline analysis lives below this marker in the
+    # committed file; regeneration must refresh the measured table
+    # WITHOUT wiping the analysis
+    md_path = os.path.join(REPO, "PROFILE_r05.md")
+    analysis = ""
+    marker = "## Roofline decomposition"
+    if os.path.exists(md_path):
+        old = open(md_path).read()
+        if marker in old:
+            analysis = "\n" + old[old.index(marker):]
+    with open(md_path, "w") as f:
+        f.write("\n".join(lines) + "\n" + analysis)
     print(json.dumps({"mfu": doc["mfu"],
                       "tokens_per_sec": doc["tokens_per_sec"]}))
 
