@@ -1,0 +1,110 @@
+"""Pretraining data source: memory-mapped token files.
+
+The reference is a library whose examples lean on external loaders
+(torchvision for imagenet; Megatron's indexed datasets for LM
+pretraining — only the batch SAMPLERS ship in apex,
+reference: apex/transformer/_data/_batchsampler.py:1-180, mirrored in
+``apex_tpu.transformer.data``).  This module supplies the missing
+source half of that pipeline, TPU-host-first:
+
+- the on-disk format is one flat little-endian token array plus a tiny
+  JSON sidecar (dtype, token count) — ``np.memmap`` gives zero-copy
+  reads straight from page cache, which IS the native IO path on a TPU
+  host (a C++ reader would wrap the same mmap(2); the bytes never pass
+  through Python loops);
+- samples are fixed-length ``seq_len + 1`` windows (input = [:-1],
+  target = [1:], the GPT next-token convention), strided by ``seq_len``
+  so every token trains exactly once per epoch;
+- ``pretraining_batches`` composes a dataset with either Megatron
+  sampler into ready-to-``device_put`` (tokens, targets) numpy pairs —
+  the host side of the dp-sharded input pipeline (each rank constructs
+  its sampler with its own ``data_parallel_rank``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, Tuple
+
+import numpy as np
+
+__all__ = [
+    "write_token_file",
+    "IndexedTokenDataset",
+    "pretraining_batches",
+]
+
+_SIDECAR = ".meta.json"
+
+
+def write_token_file(path: str, tokens, dtype="uint16") -> str:
+    """Write a flat token array + sidecar; returns ``path``.
+
+    ``dtype`` uint16 fits vocabs < 65536 (GPT-2's 50k needs uint32 —
+    validated against the data's max token).
+    """
+    arr = np.asarray(tokens)
+    dtype = np.dtype(dtype)  # accepts "uint16" and np.uint16 alike
+    info = np.iinfo(dtype)
+    if arr.size and (arr.min() < info.min or arr.max() > info.max):
+        raise ValueError(
+            f"token ids [{arr.min()}, {arr.max()}] do not fit {dtype}"
+        )
+    arr.astype(dtype).tofile(path)
+    with open(path + _SIDECAR, "w") as f:
+        json.dump({"dtype": dtype.name, "n_tokens": int(arr.size),
+                   "max_token": int(arr.max()) if arr.size else -1}, f)
+    return path
+
+
+class IndexedTokenDataset:
+    """Fixed-window LM samples over a memory-mapped token file."""
+
+    def __init__(self, path: str, seq_len: int):
+        with open(path + _SIDECAR) as f:
+            meta = json.load(f)
+        self.seq_len = int(seq_len)
+        self.tokens = np.memmap(
+            path, dtype=meta["dtype"], mode="r", shape=(meta["n_tokens"],)
+        )
+        # sidecar-recorded vocabulary bound (one mmap scan for files
+        # written before the field existed) — lets consumers fail fast
+        # on a corpus/model vocab mismatch instead of training on
+        # clamped/masked garbage embeddings
+        self.max_token = int(
+            meta.get("max_token", self.tokens.max() if meta["n_tokens"]
+                     else -1)
+        )
+        # windows of seq_len+1, strided by seq_len: sample i covers
+        # tokens [i*s, i*s + s], so consecutive samples overlap by the
+        # one boundary token that becomes both a target and an input
+        self.n_samples = max(0, (meta["n_tokens"] - 1) // self.seq_len)
+        if self.n_samples == 0:
+            raise ValueError(
+                f"{path}: {meta['n_tokens']} tokens < one "
+                f"seq_len+1={seq_len + 1} window"
+            )
+
+    def __len__(self) -> int:
+        return self.n_samples
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if not 0 <= i < self.n_samples:
+            raise IndexError(i)
+        start = i * self.seq_len
+        # copy: a memmap slice pins the mapping; batches should be
+        # plain host arrays by the time they reach device_put
+        return np.asarray(self.tokens[start: start + self.seq_len + 1],
+                          dtype=np.int32)
+
+
+def pretraining_batches(
+    dataset: IndexedTokenDataset, sampler
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yield this rank's (tokens, targets) micro-batches, each
+    (micro_batch, seq_len) int32 — feed straight to the dp-sharded
+    train step."""
+    for idx_batch in sampler:
+        window = np.stack([dataset[i] for i in idx_batch])
+        yield window[:, :-1], window[:, 1:]

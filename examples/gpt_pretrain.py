@@ -41,12 +41,41 @@ from apex_tpu.utils.autoresume import AutoResume
 
 
 def batches(rng, n_batches, global_batch, seq, vocab):
-    """Pre-generated synthetic LM batches (plug a real corpus here)."""
+    """Pre-generated synthetic LM batches (see --data for a corpus)."""
     pool = []
     for _ in range(n_batches):
         tokens = jnp.asarray(
             rng.integers(0, vocab, (global_batch, seq)), jnp.int32)
         pool.append((tokens, jnp.roll(tokens, -1, axis=1)))
+    return pool
+
+
+def file_batches(path, n_batches, global_batch, seq, vocab):
+    """Real-corpus pool from an apex_tpu.data mmap token file: windows
+    via IndexedTokenDataset, order via MegatronPretrainingSampler (the
+    whole global batch is materialized here and dp-sharded by the
+    step's P("dp") in_spec, so the sampler runs as one logical rank)."""
+    from apex_tpu.data import IndexedTokenDataset, pretraining_batches
+    from apex_tpu.transformer.data import MegatronPretrainingSampler
+
+    ds = IndexedTokenDataset(path, seq_len=seq)
+    if ds.max_token >= vocab:
+        raise ValueError(
+            f"{path}: corpus max token id {ds.max_token} >= model vocab "
+            f"{vocab} — out-of-range ids would train on clamped/masked "
+            f"embeddings silently")
+    sampler = MegatronPretrainingSampler(
+        total_samples=len(ds), consumed_samples=0,
+        micro_batch_size=global_batch,
+        data_parallel_rank=0, data_parallel_size=1,
+    )
+    pool = []
+    for toks, tgts in pretraining_batches(ds, sampler):
+        pool.append((jnp.asarray(toks), jnp.asarray(tgts)))
+        if len(pool) >= n_batches:
+            break
+    if not pool:
+        raise ValueError(f"{path}: fewer than {global_batch} windows")
     return pool
 
 
@@ -79,6 +108,9 @@ def main(argv=None):
                     choices=["gelu", "swiglu"])
     ap.add_argument("--normalization", default="layernorm",
                     choices=["layernorm", "rmsnorm"])
+    ap.add_argument("--data", default=None,
+                    help="apex_tpu.data token file (write_token_file); "
+                         "synthetic stream when omitted")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--save-every", type=int, default=25)
     args = ap.parse_args(argv)
@@ -231,8 +263,10 @@ def main(argv=None):
                      else place(opt_state, opt_specs))
 
     global_batch = args.micro_batch * args.num_micro * dp
-    pool = batches(np.random.default_rng(0), 8, global_batch,
-                   args.seq, args.vocab)
+    pool = (file_batches(args.data, 8, global_batch, args.seq, args.vocab)
+            if args.data else
+            batches(np.random.default_rng(0), 8, global_batch,
+                    args.seq, args.vocab))
     t0, timed, lv = None, 0, float("nan")
     for i in range(start, args.steps):
         tokens, targets = pool[i % len(pool)]

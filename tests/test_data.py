@@ -1,0 +1,84 @@
+"""Pretraining data source (apex_tpu.data): mmap token files + the
+sampler composition — the source half of the Megatron input pipeline
+whose sampler half mirrors the reference
+(apex/transformer/_data/_batchsampler.py)."""
+
+import numpy as np
+import pytest
+
+from apex_tpu.data import (
+    IndexedTokenDataset,
+    pretraining_batches,
+    write_token_file,
+)
+from apex_tpu.transformer.data import MegatronPretrainingSampler
+
+
+def _make(tmp_path, n_tokens=1000, dtype="uint16"):
+    path = str(tmp_path / "toks.bin")
+    tokens = np.arange(n_tokens) % 611  # recognizable, nonuniform
+    write_token_file(path, tokens, dtype=dtype)
+    return path, tokens
+
+
+def test_windows_cover_every_token_once(tmp_path):
+    path, tokens = _make(tmp_path)
+    ds = IndexedTokenDataset(path, seq_len=16)
+    assert len(ds) == (1000 - 1) // 16
+    seen = []
+    for i in range(len(ds)):
+        w = ds[i]
+        assert w.shape == (17,) and w.dtype == np.int32
+        np.testing.assert_array_equal(w, tokens[i * 16: i * 16 + 17])
+        seen.extend(w[:-1])  # inputs
+    # inputs tile the prefix of the file exactly once
+    np.testing.assert_array_equal(seen, tokens[: len(ds) * 16])
+
+
+def test_target_is_shifted_input(tmp_path):
+    path, _ = _make(tmp_path)
+    ds = IndexedTokenDataset(path, seq_len=8)
+    sampler = MegatronPretrainingSampler(
+        total_samples=len(ds), consumed_samples=0, micro_batch_size=4,
+        data_parallel_rank=0, data_parallel_size=1,
+    )
+    toks, tgts = next(iter(pretraining_batches(ds, sampler)))
+    assert toks.shape == tgts.shape == (4, 8)
+    np.testing.assert_array_equal(toks[:, 1:], tgts[:, :-1])
+
+
+def test_dp_ranks_get_disjoint_samples(tmp_path):
+    path, _ = _make(tmp_path)
+    ds = IndexedTokenDataset(path, seq_len=8)
+
+    def first_batch(rank):
+        s = MegatronPretrainingSampler(
+            total_samples=len(ds), consumed_samples=0, micro_batch_size=2,
+            data_parallel_rank=rank, data_parallel_size=4,
+        )
+        toks, _ = next(iter(pretraining_batches(ds, s)))
+        return toks
+
+    batches = [first_batch(r) for r in range(4)]
+    # disjoint windows: the 4x2 first-batch inputs across ranks tile
+    # the first 8 dataset samples exactly, nothing shared or skipped
+    flat = np.sort(np.concatenate([b.ravel() for b in batches]))
+    expect = np.sort(np.concatenate([ds_window for ds_window in (
+        IndexedTokenDataset(path, seq_len=8)[i][:-1] for i in range(8))]))
+    np.testing.assert_array_equal(flat, expect)
+
+
+def test_dtype_bounds_checked(tmp_path):
+    with pytest.raises(ValueError, match="do not fit"):
+        write_token_file(str(tmp_path / "x.bin"), [0, 70000],
+                         dtype="uint16")
+    path = write_token_file(str(tmp_path / "y.bin"),
+                            np.arange(100_000) % 70_000, dtype="uint32")
+    ds = IndexedTokenDataset(path, seq_len=32)
+    assert ds[0][0] == 0
+
+
+def test_too_small_file_raises(tmp_path):
+    path = write_token_file(str(tmp_path / "z.bin"), np.arange(8))
+    with pytest.raises(ValueError, match="window"):
+        IndexedTokenDataset(path, seq_len=16)
