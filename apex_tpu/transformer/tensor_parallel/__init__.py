@@ -32,10 +32,12 @@ from apex_tpu.transformer.tensor_parallel.random import (  # noqa: F401
 )
 from apex_tpu.transformer.tensor_parallel.utils import (  # noqa: F401
     VocabUtility,
+    clip_grad_norm,
     split_tensor_along_last_dim,
 )
 
 __all__ = [
+    "clip_grad_norm",
     "copy_to_tensor_model_parallel_region",
     "gather_from_tensor_model_parallel_region",
     "reduce_from_tensor_model_parallel_region",

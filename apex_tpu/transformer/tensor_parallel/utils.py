@@ -7,7 +7,8 @@ from typing import Sequence, Tuple
 
 import jax.numpy as jnp
 
-__all__ = ["VocabUtility", "split_tensor_along_last_dim"]
+__all__ = ["VocabUtility", "split_tensor_along_last_dim",
+           "clip_grad_norm"]
 
 
 def split_tensor_along_last_dim(x: jnp.ndarray, num_partitions: int) -> Sequence:
@@ -43,3 +44,66 @@ class VocabUtility:
         return VocabUtility.vocab_range_from_per_partition_vocab_size(
             global_vocab_size // world_size, rank, world_size
         )
+
+
+def clip_grad_norm(
+    grads,
+    specs,
+    max_norm: float,
+    *,
+    eps: float = 1e-12,
+):
+    """Global-norm gradient clipping that is correct under model
+    parallelism — the mesh-aware extension of the reference's
+    single-device ``FP16_Optimizer.clip_master_grads``
+    (reference: apex/fp16_utils/fp16_optimizer.py, "clip_master_grads";
+    the Megatron lineage calls this the duplicate-aware
+    ``clip_grad_norm``).
+
+    Inside ``shard_map``, a leaf whose ``PartitionSpec`` mentions a
+    mesh axis holds only its SHARD of the parameter: its squared-norm
+    contribution is psum'd over that axis.  A leaf whose spec does not
+    mention an axis is replicated there (every rank holds identical
+    grads after the model's internal reductions): it counts exactly
+    once, NOT psum'd — summing duplicates would inflate the norm by the
+    axis size.  The rule keys on the spec itself, with no hardcoded
+    axis list: tp/pp-sharded weights psum over tp/pp, and MoE expert
+    leaves riding "dp" as the ep axis (``ParallelMLP.param_specs()``)
+    psum over dp — each dp rank holds DIFFERENT experts, so skipping
+    that psum would give every rank a different "global" norm and
+    desynchronize training silently.
+
+    ``grads``/``specs`` are matching pytrees (``model.param_specs()``).
+    Returns ``(clipped_grads, global_norm)`` — identical on every rank
+    by construction.
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec
+
+    from apex_tpu.transformer.parallel_state import spec_axis_names
+
+    leaves, treedef = jax.tree.flatten(grads)
+    spec_leaves, spec_treedef = jax.tree.flatten(
+        specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+    if spec_treedef != treedef:
+        raise ValueError(
+            f"grads/specs structure mismatch: {treedef} vs {spec_treedef}"
+        )
+    # bucket local squared sums by the sorted tuple of mesh axes that
+    # shard the leaf; () = replicated everywhere
+    sums = {}
+    for g, sp in zip(leaves, spec_leaves):
+        axes = tuple(sorted(set(spec_axis_names(sp))))
+        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        sums[axes] = sums.get(axes, 0.0) + sq
+    total = jnp.float32(0.0)
+    for axes, sq in sums.items():
+        for ax in axes:
+            sq = lax.psum(sq, ax)
+        total = total + sq
+    norm = jnp.sqrt(total)
+    clip = jnp.minimum(1.0, max_norm / jnp.maximum(norm, eps))
+    clipped = [g * clip.astype(g.dtype) for g in leaves]
+    return jax.tree.unflatten(treedef, clipped), norm
