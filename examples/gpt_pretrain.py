@@ -37,6 +37,7 @@ from apex_tpu.optimizers import FusedAdam
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.amp import model_parallel_all_finite
 from apex_tpu.transformer.tensor_parallel.layers import state_specs_like
+from apex_tpu.transformer.tensor_parallel import clip_grad_norm
 from apex_tpu.utils.autoresume import AutoResume
 
 
@@ -108,6 +109,8 @@ def main(argv=None):
                     choices=["gelu", "swiglu"])
     ap.add_argument("--normalization", default="layernorm",
                     choices=["layernorm", "rmsnorm"])
+    ap.add_argument("--clip-grad", type=float, default=None,
+                    help="global-norm gradient clipping (mesh-aware)")
     ap.add_argument("--data", default=None,
                     help="apex_tpu.data token file (write_token_file); "
                          "synthetic stream when omitted")
@@ -210,6 +213,11 @@ def main(argv=None):
                     f, axis_names=axes))
         else:
             finite = None
+        if args.clip_grad is not None:
+            # AFTER unscale (clip sees true-magnitude grads), BEFORE the
+            # optimizer; duplicate-aware over the mesh (tp/pp shards +
+            # expert-dp leaves psum, replicated leaves count once)
+            grads, _ = clip_grad_norm(grads, specs, args.clip_grad)
         if args.zero:
             # expert grads are optimizer-ready in BOTH paths here: the
             # pipeline's data_reduce applies the 1/n itself, and the
