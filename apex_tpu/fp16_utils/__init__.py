@@ -143,7 +143,13 @@ class FP16_Optimizer:
         }
 
     def clip_master_grads(self, grads: Any, max_norm: float) -> Any:
-        """(reference: fp16_optimizer.py ``clip_master_grads``)"""
+        """(reference: fp16_optimizer.py ``clip_master_grads``).
+
+        Single-device semantics, matching the reference API.  On a
+        sharded mesh use the duplicate-aware
+        :func:`apex_tpu.transformer.tensor_parallel.clip_grad_norm`,
+        which psums each leaf over exactly the axes its spec shards.
+        """
         norm = jnp.sqrt(
             sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                 for g in jax.tree.leaves(grads))
