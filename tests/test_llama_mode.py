@@ -163,3 +163,24 @@ def test_validation_errors():
         _cfg(normalization="batchnorm")
     with pytest.raises(ValueError, match="MoE experts"):
         _cfg(num_experts=4)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    """The llama-mode params pytree (no norm biases, fc_gate leaves,
+    no position table) survives the flat-blob checkpoint byte-exactly."""
+    from apex_tpu import checkpoint
+
+    mesh = parallel_state.initialize_model_parallel()
+    try:
+        model = GPTModel(_cfg())
+        params = model.init(jax.random.PRNGKey(0))
+        path = str(tmp_path / "llama.ckpt")
+        checkpoint.save(path, {"params": params, "step": jnp.int32(7)})
+        back = checkpoint.restore(path)
+        assert int(back["step"]) == 7
+        la, lb = jax.tree.leaves(params), jax.tree.leaves(back["params"])
+        assert len(la) == len(lb)
+        for a, b in zip(la, lb):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        parallel_state.destroy_model_parallel()
