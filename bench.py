@@ -36,7 +36,13 @@ import time
 FLAGSHIP = dict(vocab_size=32768, num_layers=12, hidden_size=1024,
                 num_attention_heads=8, seq=1024, batch=8)
 
-PROBE_TIMEOUT = int(os.environ.get("APEX_BENCH_PROBE_TIMEOUT", "120"))
+# one LONG probe window, not many short ones: a free chip grants in
+# ~20 s so the cap never binds in the good case, while during a pool
+# wedge a queued claim must WAIT (r5 watcher data: claim requests are
+# told "no" only after ~25 min) — short probes always die mid-queue and
+# every SIGTERM'd teardown is itself a re-wedge risk.  1440 s keeps
+# MEASURE_RESERVE intact within the default 3000 s gate budget.
+PROBE_TIMEOUT = int(os.environ.get("APEX_BENCH_PROBE_TIMEOUT", "1440"))
 CHILD_TIMEOUT = int(os.environ.get("APEX_BENCH_CHILD_TIMEOUT", "1200"))
 TOTAL_BUDGET = int(os.environ.get("APEX_BENCH_TOTAL_BUDGET", "3000"))
 # Time reserved after a successful probe for the actual measurement
