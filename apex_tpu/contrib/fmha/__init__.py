@@ -13,6 +13,16 @@ production stacks express varlen attention.  The segment masking happens
 *inside* the flash kernel (``ops/attention.py``), so unlike the
 reference's seqlen<=512 window this path has no length limit and never
 materialises the (s, s) score matrix.
+
+Short-sequence dispatch: the reference's whole reason for its
+{128,256,384,512} per-seqlen kernels is that short sequences want a
+different schedule.  This wrapper now gets the same specialization for
+free — ``flash_attention(implementation=None)`` auto-routes to the
+single-pass fmha-short kernel (``ops/attention_short.py``) whenever
+``max_seq_len`` is at or below the measured crossover, so a packed
+batch in the reference's own seqlen window runs the short schedule
+while longer batches keep the online-softmax flash kernel.  Pass
+``implementation="short"`` (or ``"pallas"``/``"xla"``) to force a path.
 """
 
 from __future__ import annotations
@@ -71,10 +81,18 @@ def fmha(
 
 
 class FMHA:
-    """Module wrapper (reference: apex/contrib/fmha/fmha.py ``FMHA``)."""
+    """Module wrapper (reference: apex/contrib/fmha/fmha.py ``FMHA``).
 
-    def __init__(self, causal: bool = False):
+    ``implementation=None`` (default) keeps the measured auto-dispatch
+    (short kernel at or below the crossover, flash above); ``"short"``
+    / ``"pallas"`` / ``"xla"`` force a path.
+    """
+
+    def __init__(self, causal: bool = False,
+                 implementation: Optional[str] = None):
         self.causal = causal
+        self.implementation = implementation
 
     def __call__(self, qkv, cu_seqlens, max_s):
-        return fmha(qkv, cu_seqlens, max_s, causal=self.causal)
+        return fmha(qkv, cu_seqlens, max_s, causal=self.causal,
+                    implementation=self.implementation)

@@ -34,14 +34,19 @@ def _xavier(key, shape, dtype, gain=1.0):
 
 
 def _attend(q, k, v, scale, mask_bias, causal, impl,
-            kv_pad_mask=None, dropout_rate=0.0, rng=None):
+            kv_pad_mask=None, dropout_rate=0.0, rng=None,
+            attention_impl=None):
     """q,k,v: (b, h, s, d).  mask_bias: additive (b,1,sq,sk) or None;
     kv_pad_mask: (b, sk) True = masked-out key (torch convention).
 
     Probability dropout happens *inside* the attention (the reference
     fuses it into its CUDA kernels via Philox; here the flash kernel's
     counter-based hash plays that role, and the 'default' XLA path draws
-    the identical mask)."""
+    the identical mask).  ``attention_impl`` is forwarded to
+    ``flash_attention`` on the 'fast' path (None = measured
+    auto-dispatch, which routes short sequences — the reference MHA
+    extensions' own seqlen regime — to the single-pass fmha-short
+    kernel)."""
     q_seg = kv_seg = None
     if kv_pad_mask is not None:
         # segment ids keep padding exclusion inside the flash kernel
@@ -59,7 +64,8 @@ def _attend(q, k, v, scale, mask_bias, causal, impl,
     )
     if impl == "fast":
         # attn_mask is a constant mask, never a parameter: skip dbias
-        return flash_attention(q, k, v, bias_requires_grad=False, **kwargs)
+        return flash_attention(q, k, v, bias_requires_grad=False,
+                               implementation=attention_impl, **kwargs)
     return mha_reference(q, k, v, **kwargs)
 
 
@@ -74,6 +80,7 @@ class _MHABase:
         impl: str = "fast",
         params_dtype: Any = jnp.float32,
         policy: Any = None,
+        attention_impl: Any = None,
     ):
         norm_dtype = params_dtype
         if policy is not None:  # amp.Policy drives the param dtypes
@@ -93,6 +100,10 @@ class _MHABase:
         self.use_bias = bias
         self.include_norm_add = include_norm_add
         self.impl = impl
+        # kernel choice for impl='fast': None = measured auto-dispatch
+        # (short kernel in the reference extensions' seqlen regime),
+        # "short"/"pallas"/"xla" force one
+        self.attention_impl = attention_impl
         self.params_dtype = params_dtype
         self.norm_dtype = norm_dtype
 
@@ -183,6 +194,7 @@ class SelfMultiheadAttn(_MHABase):
             q, k, v, self.scale, bias, causal, self.impl,
             kv_pad_mask=key_padding_mask,
             dropout_rate=self.dropout if is_training else 0.0, rng=rng,
+            attention_impl=self.attention_impl,
         )
         out = jnp.matmul(
             self._bhsd_to_sbh(ctx), params["out_weight"].astype(ctx.dtype)
@@ -252,6 +264,7 @@ class EncdecMultiheadAttn(_MHABase):
             q, k_, v_, self.scale, None, False, self.impl,
             kv_pad_mask=key_padding_mask,
             dropout_rate=self.dropout if is_training else 0.0, rng=rng,
+            attention_impl=self.attention_impl,
         )
         out = jnp.matmul(
             self._bhsd_to_sbh(ctx), params["out_weight"].astype(ctx.dtype)

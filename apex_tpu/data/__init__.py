@@ -46,6 +46,13 @@ def write_token_file(path: str, tokens, dtype="uint16") -> str:
     """
     arr = np.asarray(tokens)
     dtype = np.dtype(dtype)  # accepts "uint16" and np.uint16 alike
+    if arr.size and not np.issubdtype(arr.dtype, np.integer):
+        # astype() would silently TRUNCATE in-range floats (3.7 → 3);
+        # token ids arriving as floats are a pipeline bug, not data
+        raise ValueError(
+            f"token ids must be an integer dtype, got {arr.dtype} "
+            "(cast explicitly if the values are known-exact)"
+        )
     info = np.iinfo(dtype)
     if arr.size and (arr.min() < info.min or arr.max() > info.max):
         raise ValueError(
@@ -64,17 +71,21 @@ class IndexedTokenDataset:
     def __init__(self, path: str, seq_len: int):
         with open(path + _SIDECAR) as f:
             meta = json.load(f)
+        self._path = path
+        self._meta = meta
         self.seq_len = int(seq_len)
         self.tokens = np.memmap(
             path, dtype=meta["dtype"], mode="r", shape=(meta["n_tokens"],)
         )
-        # sidecar-recorded vocabulary bound (one mmap scan for files
-        # written before the field existed) — lets consumers fail fast
+        # sidecar-recorded vocabulary bound — lets consumers fail fast
         # on a corpus/model vocab mismatch instead of training on
-        # clamped/masked garbage embeddings
-        self.max_token = int(
-            meta.get("max_token", self.tokens.max() if meta["n_tokens"]
-                     else -1)
+        # clamped/masked garbage embeddings.  For legacy sidecars
+        # (written before the field existed) the full-file mmap scan is
+        # LAZY: construction stays O(1), the scan runs on first access,
+        # and its result is written back so it runs once per corpus,
+        # not once per process
+        self._max_token = (
+            int(meta["max_token"]) if "max_token" in meta else None
         )
         # windows of seq_len+1, strided by seq_len: sample i covers
         # tokens [i*s, i*s + s], so consecutive samples overlap by the
@@ -85,6 +96,24 @@ class IndexedTokenDataset:
                 f"{path}: {meta['n_tokens']} tokens < one "
                 f"seq_len+1={seq_len + 1} window"
             )
+
+    @property
+    def max_token(self) -> int:
+        if self._max_token is None:
+            self._max_token = int(
+                self.tokens.max()) if self._meta["n_tokens"] else -1
+            meta = dict(self._meta, max_token=self._max_token)
+            try:  # upgrade the legacy sidecar — atomically, so a
+                # concurrent reader never sees a truncated file and
+                # racing writers last-write-win whole documents
+                tmp = f"{self._path}{_SIDECAR}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(meta, f)
+                os.replace(tmp, self._path + _SIDECAR)
+                self._meta = meta
+            except OSError:
+                pass  # read-only corpus dir: keep the value in-process
+        return self._max_token
 
     def __len__(self) -> int:
         return self.n_samples

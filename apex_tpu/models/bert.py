@@ -55,7 +55,9 @@ class BertConfig:
     fused_ce: Optional[bool] = None
     fused_ce_chunk: int = 8192
     add_binary_head: bool = True
-    attention_impl: Optional[str] = None  # "pallas" | "xla" | None=auto
+    # "short" | "pallas" | "xla" | None = auto (measured windows: BERT's
+    # typical s<=512 encoder runs the single-pass fmha-short kernel)
+    attention_impl: Optional[str] = None
 
     def __post_init__(self):
         if self.policy is not None:

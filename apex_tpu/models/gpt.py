@@ -83,7 +83,13 @@ class GPTConfig:
     activation: str = "gelu"
     # "layernorm" (scale+bias, reference) or "rmsnorm" (scale only)
     normalization: str = "layernorm"
-    ffn_hidden_size: Optional[int] = None  # defaults to 4*hidden
+    # defaults to 4*hidden for BOTH activations.  NOTE for swiglu
+    # users: swiglu carries 3 FFN matrices (gate/up/down) vs gelu's 2,
+    # so at equal ffn_hidden_size a swiglu model has 1.5x the FFN
+    # params.  For parameter-matched comparisons with gelu models set
+    # ffn_hidden_size ≈ int(8 * hidden_size / 3), rounded to a multiple
+    # of the tp width x 128 lanes (the Llama convention; docs/models.md)
+    ffn_hidden_size: Optional[int] = None
     hidden_dropout: float = 0.0
     attention_dropout: float = 0.0
     layernorm_epsilon: float = 1e-5
@@ -109,7 +115,10 @@ class GPTConfig:
     # scan that never materializes logits.  True/False forces a path.
     fused_ce: Optional[bool] = None
     fused_ce_chunk: int = 8192
-    attention_impl: Optional[str] = None  # None → pick by platform
+    # None → platform + measured dispatch windows (short sequences run
+    # the single-pass fmha-short kernel, ops/attention_short.py);
+    # "short"/"pallas"/"xla" force one attention kernel everywhere
+    attention_impl: Optional[str] = None
     # shard the sequence dim over the "cp" mesh axis and use ring
     # attention — long-context training (new capability vs the reference,
     # SURVEY.md §2.3); tokens then arrive as the local (b, s/cp) shard

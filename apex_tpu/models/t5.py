@@ -79,6 +79,10 @@ class T5Config:
     remat_policy: Optional[str] = "dots_with_no_batch_dims_saveable"
     fused_ce: Optional[bool] = None
     fused_ce_chunk: int = 8192
+    # "short" | "pallas" | "xla" | None = auto — the short-decoder /
+    # short-encoder shapes T5 trains at sit inside the fmha-short
+    # dispatch window (ops/attention_short.py), including both
+    # self-attention and the sq!=sk cross-attention calls below
     attention_impl: Optional[str] = None
     # route the pipeline path through pipeline_encdec_fused: ONE
     # homogeneous stage body per tick (gated cross-attention +

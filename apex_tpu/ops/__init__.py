@@ -23,8 +23,12 @@ from apex_tpu.ops.attention import (  # noqa: F401
     flash_attention,
     mha_reference,
 )
+from apex_tpu.ops.attention_short import (  # noqa: F401
+    fmha_short,
+)
 
 __all__ = [
+    "fmha_short",
     "fused_layer_norm",
     "fused_layer_norm_affine",
     "fused_rms_norm",

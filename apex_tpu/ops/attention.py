@@ -354,7 +354,9 @@ def _fwd_in_specs(cfg, d, psq, psk, has_bias, has_segs, has_dropout,
 
 
 def _compiler_params():
-    return pltpu.CompilerParams(
+    from apex_tpu.ops.common import tpu_compiler_params
+
+    return tpu_compiler_params(
         dimension_semantics=("parallel", "parallel", "arbitrary")
     )
 
@@ -817,8 +819,14 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Flash attention over ``(batch, heads, seq, head_dim)``.
 
-    ``implementation`` is ``"pallas"`` (TPU kernel) or ``"xla"``
-    (reference path, also the CPU fallback); default picks by platform.
+    ``implementation`` is ``"pallas"`` (TPU kernel), ``"xla"``
+    (reference path, also the CPU fallback), or ``"short"`` (the
+    single-pass short-sequence kernel family in
+    ``ops/attention_short.py`` — the analog of the reference's
+    per-seqlen {128,256,384,512} fmha kernels); default picks by
+    platform and the measured dispatch windows.  ``block_q``/``block_k``
+    only apply to the flash kernel (the short kernel holds the whole
+    sequence and blocks the batch*heads dimension instead).
 
     ``bias`` is an additive score bias broadcastable from
     ``(1|b, 1|h, sq, sk)``; it is differentiable by default (the backward
@@ -848,10 +856,25 @@ def flash_attention(
         bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
     from apex_tpu.ops.common import KernelLoweringError, run_kernel
 
-    if pl is None and implementation == "pallas":
+    if pl is None and implementation in ("pallas", "short"):
         raise KernelLoweringError(
-            "implementation='pallas' requested but Pallas failed to import"
+            f"implementation={implementation!r} requested but Pallas "
+            "failed to import"
         )
+
+    def _short_path(forced: bool):
+        from apex_tpu.ops.attention_short import fmha_short
+
+        return fmha_short(
+            q, k, v, causal=causal, sm_scale=sm_scale, bias=bias,
+            q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+            bias_requires_grad=bias_requires_grad,
+            implementation="pallas" if forced else None,
+        )
+
+    if implementation == "short":
+        return _short_path(forced=True)
     impl = implementation or default_implementation()
     if (
         implementation is None
@@ -867,6 +890,19 @@ def flash_attention(
         # analog of the reference's kernel-availability windows
         # (apex/transformer/functional/fused_softmax.py:151-171)
         impl = "xla"
+    if implementation is None and impl == "pallas":
+        from apex_tpu.ops.attention_short import short_seq_threshold
+
+        thr = short_seq_threshold()
+        if q.shape[2] <= thr and k.shape[2] <= thr:
+            # short-sequence window: the whole kv fits one k-block, so
+            # the single-pass fmha-short kernel drops the online-softmax
+            # machinery and packs (batch*heads) programs per grid step
+            # (crossover constant FMHA_SHORT_MAX_SEQ, recorded/gated by
+            # tools/kernel_validation.py).  Note ordering: the fp32→XLA
+            # window above fires first, so fp32 short sequences keep
+            # their measured XLA routing until a capture says otherwise.
+            return _short_path(forced=False)
     if pl is None:
         impl = "xla"
 

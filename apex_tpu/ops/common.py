@@ -7,7 +7,20 @@ import os
 
 import jax
 
-__all__ = ["shape_struct", "run_kernel", "KernelLoweringError"]
+__all__ = [
+    "shape_struct", "run_kernel", "KernelLoweringError",
+    "tpu_compiler_params",
+]
+
+
+def tpu_compiler_params(**kwargs):
+    """Version-portable ``pltpu.CompilerParams`` (renamed from
+    ``TPUCompilerParams`` across jax releases; 0.4.x ships the old
+    name)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
 
 _logger = logging.getLogger("apex_tpu")
 
@@ -61,6 +74,8 @@ def shape_struct(shape, dtype, *varying_like) -> jax.ShapeDtypeStruct:
     try:
         sets = [jax.typeof(x).vma for x in varying_like]
         vma = frozenset().union(*sets) if sets else frozenset()
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     except Exception:
-        vma = None
-    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        # jax without typeof().vma / the ShapeDtypeStruct vma kwarg:
+        # plain struct (check_vma shard_map is unavailable there anyway)
+        return jax.ShapeDtypeStruct(shape, dtype)

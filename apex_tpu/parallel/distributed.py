@@ -36,6 +36,14 @@ __all__ = [
 ]
 
 
+def _axis_size(axis_name):
+    """Version-portable ``jax.lax.axis_size`` (absent in jax 0.4.x,
+    where the axis extent comes from the bound mesh context)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def data_parallel_mesh(
     devices: Optional[Sequence] = None, axis_name: str = "dp"
 ) -> Mesh:
@@ -73,7 +81,7 @@ def _hierarchical_psum(g: jnp.ndarray, dcn_axis: str, ici_axis: str):
     1/ici of the tensor (the reference's 2-level reduce,
     distributed_fused_adam.py:106-160)."""
     n = g.size
-    ici = jax.lax.axis_size(ici_axis)
+    ici = _axis_size(ici_axis)
     flat = g.reshape(-1)
     pad = (-n) % ici
     if pad:
@@ -110,9 +118,9 @@ def all_reduce_gradients(
     hierarchical = isinstance(axis_name, (tuple, list))
     if hierarchical:
         dcn_axis, ici_axis = axis_name
-        world = jax.lax.axis_size(dcn_axis) * jax.lax.axis_size(ici_axis)
+        world = _axis_size(dcn_axis) * _axis_size(ici_axis)
     else:
-        world = jax.lax.axis_size(axis_name)
+        world = _axis_size(axis_name)
 
     def sync(g):
         orig_dtype = g.dtype
@@ -241,12 +249,20 @@ class Reducer:
     reduction" would not exist to defer.  Marking the params varying
     first keeps the per-device gradients local until ``reduce`` — which
     is the entire point of the reference's Reducer (delaying the
-    allreduce across accumulation steps).  Scaling semantics match
-    :func:`all_reduce_gradients`: with ``gradient_average=True`` (the
-    reference's behavior) ``reduce`` also divides by the number of
-    accumulated microbatches, yielding the mean gradient over
-    (axis world x K local steps); with ``gradient_average=False`` it
-    returns the raw sum over both.  ``allreduce_always_fp32`` is
+    allreduce across accumulation steps).
+
+    Scaling semantics — a DELIBERATE DEVIATION from the reference: the
+    reference's Reducer averages only over the world size
+    (apex/parallel/distributed.py), returning the SUM over the K
+    locally accumulated microbatches.  Here ``gradient_average=True``
+    (default) also divides by K, yielding the mean gradient over
+    (axis world x K local steps) — so the effective learning rate does
+    not silently scale with the accumulation count.  Pass
+    ``average_over_microbatches=False`` to reproduce the reference
+    scaling exactly (mean over world, sum over K — what you want when
+    porting a reference training recipe whose lr schedule was tuned
+    against that convention); with ``gradient_average=False`` both
+    flags yield the raw sum over both.  ``allreduce_always_fp32`` is
     accepted for signature parity but meaningless here — the
     accumulator is ALWAYS fp32 (see :meth:`init`), so the reduction
     already runs in fp32 regardless.
@@ -258,11 +274,13 @@ class Reducer:
         gradient_average: bool = True,
         gradient_predivide_factor: float = 1.0,
         allreduce_always_fp32: bool = False,
+        average_over_microbatches: bool = True,
     ):
         self.axis_name = axis_name
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
+        self.average_over_microbatches = average_over_microbatches
 
     def init(self, params: Any) -> dict:
         """Zero accumulator state (fp32 buffers — accumulation across
@@ -286,8 +304,10 @@ class Reducer:
     def reduce(self, state: dict) -> tuple:
         """One collective over everything accumulated; returns
         ``(grads, fresh_state)`` — the mean over (world x count) when
-        ``gradient_average``, the raw sum otherwise."""
-        if self.gradient_average:
+        ``gradient_average`` (over world only when
+        ``average_over_microbatches=False``, the reference scaling),
+        the raw sum otherwise."""
+        if self.gradient_average and self.average_over_microbatches:
             n = jnp.maximum(state["count"], 1).astype(jnp.float32)
             grads = jax.tree.map(lambda a: a / n, state["sum"])
         else:

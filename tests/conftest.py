@@ -26,6 +26,9 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+import signal  # noqa: E402
+import threading  # noqa: E402
+
 import pytest  # noqa: E402
 
 # Long-running tests (measured: tests/run_tests.sh keeps `-m l0` around
@@ -71,6 +74,44 @@ SLOW_TESTS = {
     "test_everything_composes",
     "test_ep_matches_dense",
 }
+
+
+# Per-test timeout for the slow tier: the full 387-test suite runs on a
+# 1-core gate host, where one wedged collective or runaway compile in a
+# slow test would otherwise eat the whole suite budget (VERDICT r5).
+# SIGALRM-based (no pytest-timeout in the image): the handler raises in
+# the main thread at the next bytecode boundary, which bounds every
+# pure-Python/jit-dispatch hang; override with
+# APEX_TPU_SLOW_TEST_TIMEOUT (seconds, 0 disables).
+SLOW_TEST_TIMEOUT_S = int(os.environ.get("APEX_TPU_SLOW_TEST_TIMEOUT",
+                                         "600"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    usable = (
+        SLOW_TEST_TIMEOUT_S > 0
+        and "slow" in item.keywords
+        and hasattr(signal, "SIGALRM")
+        and threading.current_thread() is threading.main_thread()
+    )
+    if not usable:
+        yield
+        return
+
+    def _alarm(signum, frame):
+        raise TimeoutError(
+            f"slow-tier test exceeded the {SLOW_TEST_TIMEOUT_S}s "
+            "per-test timeout (APEX_TPU_SLOW_TEST_TIMEOUT to adjust)"
+        )
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.setitimer(signal.ITIMER_REAL, SLOW_TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
 
 
 def pytest_collection_modifyitems(config, items):

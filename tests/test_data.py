@@ -82,3 +82,41 @@ def test_too_small_file_raises(tmp_path):
     path = write_token_file(str(tmp_path / "z.bin"), np.arange(8))
     with pytest.raises(ValueError, match="window"):
         IndexedTokenDataset(path, seq_len=16)
+
+
+def test_float_token_ids_rejected(tmp_path):
+    # astype() would silently truncate in-range floats — reject instead
+    with pytest.raises(ValueError, match="integer dtype"):
+        write_token_file(str(tmp_path / "f.bin"),
+                         np.array([1.0, 2.5, 3.0]), dtype="uint16")
+    # exact-valued floats are still floats: the caller must cast
+    with pytest.raises(ValueError, match="integer dtype"):
+        write_token_file(str(tmp_path / "g.bin"),
+                         np.array([1.0, 2.0]), dtype="uint16")
+    # explicit integer cast is the sanctioned path
+    write_token_file(str(tmp_path / "h.bin"),
+                     np.array([1.0, 2.0]).astype(np.int64), dtype="uint16")
+
+
+def test_legacy_sidecar_max_token_lazy_and_rewritten(tmp_path):
+    import json
+
+    path, tokens = _make(tmp_path, n_tokens=500)
+    sidecar = path + ".meta.json"
+    with open(sidecar) as f:
+        meta = json.load(f)
+    del meta["max_token"]  # simulate a pre-field legacy sidecar
+    with open(sidecar, "w") as f:
+        json.dump(meta, f)
+
+    ds = IndexedTokenDataset(path, seq_len=16)
+    # construction must NOT have scanned (nothing written back yet)
+    with open(sidecar) as f:
+        assert "max_token" not in json.load(f)
+    # first access scans once and upgrades the sidecar in place
+    assert ds.max_token == int(tokens.max())
+    with open(sidecar) as f:
+        assert json.load(f)["max_token"] == int(tokens.max())
+    # a fresh dataset now reads the recorded value (no rescan path)
+    ds2 = IndexedTokenDataset(path, seq_len=16)
+    assert ds2._max_token == int(tokens.max())

@@ -401,3 +401,35 @@ class TestReducer:
         ))(jnp.arange(8.0).reshape(8, 1))
         # sum over devices (0+..+7 = 28) x 2 accumulations
         assert float(out[0]) == 56.0
+
+    def test_reference_scaling_flag(self, mesh):
+        """average_over_microbatches=False reproduces the reference
+        Reducer's scaling: mean over world, SUM over the K accumulated
+        microbatches (the default deliberately deviates by also
+        dividing by K — Reducer docstring)."""
+        from apex_tpu.parallel import Reducer
+
+        try:
+            shard_map = jax.shard_map
+        except AttributeError:  # jax 0.4.x spelling
+            from jax.experimental.shard_map import shard_map
+
+        ours = Reducer(axis_name="dp")
+        ref = Reducer(axis_name="dp", average_over_microbatches=False)
+
+        def step(x):
+            outs = []
+            for red in (ours, ref):
+                acc = red.init(x[0])
+                for _ in range(4):  # K=4 identical microbatches
+                    acc = red.accumulate(acc, x[0])
+                g, _ = red.reduce(acc)
+                outs.append(g)
+            return tuple(outs)
+
+        g_ours, g_ref = jax.jit(shard_map(
+            step, mesh=mesh, in_specs=(P("dp"),), out_specs=(P(), P()),
+        ))(jnp.arange(8.0).reshape(8, 1))
+        # mean over world of the per-device value 0..7 is 3.5
+        assert float(g_ours[0]) == 3.5        # also averaged over K
+        assert float(g_ref[0]) == 3.5 * 4     # reference: sum over K

@@ -243,6 +243,189 @@ def validate_flash(smoke=False):
 
 
 # ---------------------------------------------------------------------------
+# fmha-short (single-pass short-sequence attention)
+# ---------------------------------------------------------------------------
+
+
+def validate_fmha_short(smoke=False):
+    """Short-vs-flash-vs-XLA sweep at the reference fmha seqlen window
+    (+1024): the measured crossover for the FMHA_SHORT_MAX_SEQ
+    auto-dispatch boundary is RECORDED here rather than hand-picked —
+    an entry whose auto routing loses to either alternative fails the
+    gate, telling the next session to move the constant."""
+    from apex_tpu.ops.attention import (
+        FLASH_FP32_XLA_MAX_SEQ,
+        flash_attention,
+        mha_reference,
+    )
+    from apex_tpu.ops.attention_short import (
+        default_block_bh,
+        fmha_short,
+        short_seq_threshold,
+    )
+
+    results = []
+    b, h, d = 4, 8, 128
+    # the reference's per-seqlen kernel window {128,256,384,512} plus
+    # 1024 (the flagship pain shape) so the crossover is bracketed
+    seqs = [128, 256, 384, 512, 1024]
+    dtypes = [jnp.bfloat16, jnp.float32]
+    if smoke:
+        seqs, dtypes = seqs[:1], dtypes[:1]
+    cases = [(s, causal) for s in seqs
+             for causal in ((True, False) if s in (512, 1024) else (True,))]
+    if smoke:
+        cases = cases[:1]
+    for s, causal in cases:
+        for dtype in dtypes:
+            kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+            shape = (b, h, s, d)
+            q = jax.random.normal(kq, shape, dtype)
+            k = jax.random.normal(kk, shape, dtype)
+            v = jax.random.normal(kv, shape, dtype)
+
+            def short_fwd(bb):
+                return jax.jit(lambda q, k, v: fmha_short(
+                    q, k, v, causal=causal, block_bh=bb,
+                    implementation="pallas",
+                ))
+
+            def short_fwd_t(bb):
+                return jax.jit(lambda q, k, v: jnp.sum(fmha_short(
+                    q, k, v, causal=causal, block_bh=bb,
+                    implementation="pallas",
+                ).astype(jnp.float32)))
+
+            def other_fwd_t(impl):
+                return jax.jit(lambda q, k, v: jnp.sum(flash_attention(
+                    q, k, v, causal=causal, implementation=impl,
+                ).astype(jnp.float32)))
+
+            def loss_t(fn_kwargs):
+                def f(q, k, v):
+                    return jnp.sum(flash_attention(
+                        q, k, v, causal=causal, **fn_kwargs
+                    ).astype(jnp.float32) ** 2)
+                lfn = jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))
+
+                def timed(q, k, v):
+                    val, grads = lfn(q, k, v)
+                    return val + sum(
+                        jnp.sum(g.astype(jnp.float32) ** 2) for g in grads
+                    )
+                return jax.jit(timed), lfn
+
+            with jax.default_matmul_precision("highest"):
+                ref = jax.jit(lambda a, bb, c: mha_reference(
+                    a, bb, c, causal=causal
+                ))(
+                    q.astype(jnp.float32), k.astype(jnp.float32),
+                    v.astype(jnp.float32),
+                )
+
+            # block_bh sweep (the short kernel's analog of the flash
+            # block sweep); the auto size is always included
+            auto_bb = default_block_bh(s, s, b * h)
+            bb_candidates = sorted({1, 2, 4, 8, 16, auto_bb})
+            sweep = {}
+            best = None
+            for bb in bb_candidates:
+                if bb > b * h:
+                    continue
+                try:
+                    ms = _time(short_fwd_t(bb), q, k, v)
+                except Exception as e:  # lowering failure = loud entry
+                    sweep[f"bh{bb}"] = {"error": str(e)[:200]}
+                    continue
+                sweep[f"bh{bb}"] = round(ms, 3)
+                if best is None or ms < best[0]:
+                    best = (ms, bb)
+            if best is None:
+                # nothing lowered: keep a loud row instead of dying with
+                # every later kernel's rows unwritten (r5 lesson)
+                results.append({
+                    "kernel": "fmha_short",
+                    "shape": list(shape),
+                    "dtype": jnp.dtype(dtype).name,
+                    "causal": causal,
+                    "block_bh_sweep_ms": sweep,
+                    "error": "no block_bh config lowered",
+                })
+                print(json.dumps(results[-1]))
+                continue
+            short_ms, bb = best
+
+            out_s = jax.device_get(short_fwd(bb)(q, k, v))
+            out_x = jax.device_get(jax.jit(lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, implementation="xla"))(q, k, v))
+            flash_ms = _time(other_fwd_t("pallas"), q, k, v)
+            xla_ms = _time(other_fwd_t("xla"), q, k, v)
+
+            # backward: short vs flash vs xla + grad parity vs xla
+            try:
+                short_l, short_lfn = loss_t(dict(
+                    implementation="short"))
+                xla_l, xla_lfn = loss_t(dict(implementation="xla"))
+                flash_l, _ = loss_t(dict(implementation="pallas"))
+                _, gp = short_lfn(q, k, v)
+                _, gx = xla_lfn(q, k, v)
+                gp, gx = jax.device_get((gp, gx))
+                bwd_s_ms = _time(short_l, q, k, v, iters=30)
+                bwd_f_ms = _time(flash_l, q, k, v, iters=30)
+                bwd_x_ms = _time(xla_l, q, k, v, iters=30)
+                bwd_err = None
+            except Exception as e:
+                gp = gx = ()
+                bwd_s_ms = bwd_f_ms = bwd_x_ms = float("nan")
+                bwd_err = str(e)[:300]
+
+            # what the shipped auto dispatch actually does for this
+            # shape (shared constants so the record cannot drift)
+            if dtype == jnp.float32 and s <= FLASH_FP32_XLA_MAX_SEQ:
+                auto_impl = "xla"
+            elif s <= short_seq_threshold():
+                auto_impl = "short"
+            else:
+                auto_impl = "pallas"
+            flops = (2.0 if causal else 4.0) * b * h * s * s * d
+            results.append({
+                "kernel": "fmha_short",
+                "shape": list(shape),
+                "dtype": jnp.dtype(dtype).name,
+                "causal": causal,
+                "best_block_bh": bb,
+                "auto_impl": auto_impl,
+                "block_bh_sweep_ms": sweep,
+                "fwd": {
+                    "short_ms": round(short_ms, 3),
+                    "flash_ms": round(flash_ms, 3),
+                    "xla_ms": round(xla_ms, 3),
+                    "speedup": round(xla_ms / short_ms, 2),
+                    "speedup_vs_flash": round(flash_ms / short_ms, 2),
+                    "short_tflops": round(flops / short_ms / 1e9, 1),
+                    "max_err_vs_fp32": _max_err(out_s, ref),
+                    "xla_err_vs_fp32": _max_err(out_x, ref),
+                },
+                "fwd_bwd": {
+                    "error": bwd_err,
+                } if bwd_err is not None else {
+                    "short_ms": round(bwd_s_ms, 3),
+                    "flash_ms": round(bwd_f_ms, 3),
+                    "xla_ms": round(bwd_x_ms, 3),
+                    "speedup": round(bwd_x_ms / bwd_s_ms, 2),
+                    "speedup_vs_flash": round(bwd_f_ms / bwd_s_ms, 2),
+                    "grad_max_rel_err": max(
+                        _max_err(a, bb_) / (float(jnp.max(jnp.abs(
+                            bb_.astype(jnp.float32)))) + 1e-6)
+                        for a, bb_ in zip(gp, gx)
+                    ),
+                },
+            })
+            print(json.dumps(results[-1]))
+    return results
+
+
+# ---------------------------------------------------------------------------
 # fused layer norm
 # ---------------------------------------------------------------------------
 
@@ -366,13 +549,18 @@ def main():
     t0 = time.time()
     entries = []
     entries += validate_flash(smoke=args.smoke)
+    entries += validate_fmha_short(smoke=args.smoke)
     entries += validate_layer_norm(smoke=args.smoke)
     entries += validate_softmax(smoke=args.smoke)
+    from apex_tpu.ops.attention_short import short_seq_threshold
     doc = {
         "device": str(jax.devices()[0]),
         "jax_version": jax.__version__,
         "smoke": bool(args.smoke),
         "wall_s": round(time.time() - t0, 1),
+        # the crossover the shipped dispatch used during this capture;
+        # fmha_short rows record whether it matches the measurement
+        "fmha_short_max_seq": short_seq_threshold(),
         "entries": entries,
     }
     with open(args.out, "w") as f:
@@ -398,9 +586,33 @@ def main():
     #     least at parity with XLA (kernels that auto-route to XLA are
     #     recorded measurements, not regressions)
     for e in entries:
+        # fmha_short rows are judged by the crossover gate (3) below:
+        # their auto_impl="pallas" means auto runs the FLASH kernel for
+        # that shape, so fwd.speedup (short-vs-xla) is not an
+        # auto-path measurement there
+        if e.get("kernel") == "fmha_short":
+            continue
         if (e.get("auto_impl", "pallas") == "pallas"
                 and e.get("fwd", e).get("speedup", 1.0) < 1.0):
             bad.append((e, "pallas slower than xla on an auto-pallas path"))
+    # (3) crossover: a shape the auto dispatch routes to the short
+    #     kernel must not lose to EITHER alternative, and a short-swept
+    #     shape routed to flash must not have left a short win on the
+    #     table — either failure means FMHA_SHORT_MAX_SEQ needs moving
+    #     to what this capture measured
+    for e in entries:
+        if e.get("kernel") != "fmha_short" or "fwd" not in e:
+            continue
+        f = e["fwd"]
+        if e.get("auto_impl") == "short":
+            if f.get("speedup", 1.0) < 1.0:
+                bad.append((e, "auto-short shape slower than xla"))
+            if f.get("speedup_vs_flash", 1.0) < 1.0:
+                bad.append((e, "auto-short shape slower than flash"))
+        elif e.get("auto_impl") == "pallas" and \
+                f.get("speedup_vs_flash", 0.0) > 1.0:
+            bad.append((e, "short kernel beats flash beyond the "
+                           "FMHA_SHORT_MAX_SEQ boundary — raise it"))
     for e, why in bad:
         print(f"GATE FAIL: {e['kernel']} {e['shape']} {e['dtype']}: {why}")
     if bad:
