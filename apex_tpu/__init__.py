@@ -79,9 +79,11 @@ from apex_tpu import rnn  # noqa: E402
 from apex_tpu import reparameterization  # noqa: E402
 
 # heavier subpackages load lazily: `apex_tpu.transformer`,
-# `apex_tpu.models`, `apex_tpu.contrib`, `apex_tpu.ops` resolve on first
+# `apex_tpu.models`, `apex_tpu.contrib`, `apex_tpu.ops`,
+# `apex_tpu.checkpoint`, `apex_tpu.resilience` resolve on first
 # attribute access
-_LAZY = ("transformer", "models", "contrib", "ops")
+_LAZY = ("transformer", "models", "contrib", "ops", "checkpoint",
+         "resilience")
 
 
 def __getattr__(name):
@@ -109,6 +111,8 @@ __all__ = [
     "models",
     "contrib",
     "ops",
+    "checkpoint",
+    "resilience",
     "logger",
     "__version__",
 ]

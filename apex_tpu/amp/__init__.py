@@ -61,6 +61,22 @@ from apex_tpu.amp.functional import (  # noqa: F401
     set_low_precision_dtype,
 )
 
+# StepGuard/DivergenceError (resilience subsystem) are re-exported here
+# because their inputs — the finite bit and the scaler state — are
+# amp's outputs; resolved lazily so `import apex_tpu` (which imports
+# amp eagerly) does not drag the whole resilience package in
+def __getattr__(name):
+    if name in ("StepGuard", "DivergenceError"):
+        from apex_tpu.resilience import guard
+
+        val = getattr(guard, name)
+        globals()[name] = val
+        return val
+    raise AttributeError(
+        f"module 'apex_tpu.amp' has no attribute {name!r}"
+    )
+
+
 __all__ = [
     "Policy",
     "get_policy",
@@ -68,6 +84,8 @@ __all__ = [
     "LossScaler",
     "ScalerState",
     "all_finite",
+    "StepGuard",
+    "DivergenceError",
     "MixedPrecision",
     "AmpState",
     "initialize",

@@ -15,25 +15,69 @@ module gives the framework one coherent facility:
 - :func:`latest_step` / step-numbered directories give the
   save-every-N / resume-latest workflow of the reference examples
   (reference: examples/imagenet/main_amp.py torch.save recipe).
+
+Integrity & fault tolerance (the resilience subsystem's storage layer):
+
+- every save records chunked CRC32 checksums of ``data.bin`` and
+  ``treedef.pkl`` in the manifest; :func:`verify` replays them
+  streaming (bounded memory on multi-GB blobs) and names exactly the
+  files that fail;
+- :func:`restore` validates the blob's byte length against the
+  manifest-computed size *before* handing it to ``csrc.unflatten`` —
+  truncation raises :class:`CheckpointCorruptError` instead of garbage
+  leaves or a native crash;
+- :func:`restore_latest_valid` walks back from the newest step past
+  corrupt / incomplete directories so one bad checkpoint never strands
+  a run (:class:`~apex_tpu.utils.autoresume.AutoResume` resumes through
+  it);
+- the write paths (sync and async) run under the bounded
+  exponential-backoff retry of :mod:`apex_tpu.resilience.retry`, so a
+  transient storage ``OSError`` costs a few jittered sleeps, not the
+  job.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
-from typing import Any, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from apex_tpu import csrc
+from apex_tpu.resilience.retry import retry_io
 
 __all__ = ["save", "restore", "latest_step", "save_step", "restore_step",
-           "save_async", "wait_pending_saves"]
+           "save_async", "wait_pending_saves", "verify",
+           "restore_latest_valid", "latest_valid_step",
+           "CheckpointCorruptError"]
+
+logger = logging.getLogger("apex_tpu.checkpoint")
 
 _MANIFEST = "manifest.json"
 _DATA = "data.bin"
+_TREEDEF = "treedef.pkl"
+
+# I/O seams: the fault-injection harness (apex_tpu.resilience.faults)
+# swaps these to deterministically fail / signal / truncate the Nth
+# write.  Production code path is identical to calling the builtins.
+_open = open
+_replace = os.replace
+
+# checksum streaming granularity; env-tunable so tests exercise the
+# multi-chunk path with tiny blobs
+_ENV_CHUNK = "APEX_TPU_CKPT_CHUNK_BYTES"
+_DEFAULT_CHUNK = 4 * 1024 * 1024
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory exists but fails integrity validation
+    (truncated blob, checksum mismatch, unreadable manifest/treedef)."""
+
 
 # ml_dtypes covers bf16 etc.; numpy alone can't name them
 try:
@@ -51,6 +95,44 @@ except Exception:  # pragma: no cover
         return np.dtype(name)
 
 
+def _chunk_bytes() -> int:
+    return max(1, int(os.environ.get(_ENV_CHUNK, str(_DEFAULT_CHUNK))))
+
+
+def _crc_chunks(data, chunk: int) -> List[int]:
+    """Chunked CRC32 of a bytes-like (memoryview-able) buffer."""
+    view = memoryview(data)
+    return [
+        zlib.crc32(view[off: off + chunk]) & 0xFFFFFFFF
+        for off in range(0, len(view), chunk)
+    ] or [0]
+
+
+def _integrity_record(files: Dict[str, Any], chunk: int) -> dict:
+    return {
+        "algo": "crc32",
+        "chunk_bytes": chunk,
+        "files": {
+            name: {
+                "nbytes": len(memoryview(data)),
+                "chunks": _crc_chunks(data, chunk),
+            }
+            for name, data in files.items()
+        },
+    }
+
+
+def _manifest_leaf_nbytes(manifest: dict) -> int:
+    """Blob size implied by the manifest's leaf shapes/dtypes."""
+    total = 0
+    for leaf in manifest["leaves"]:
+        n = 1
+        for d in leaf["shape"]:
+            n *= int(d)
+        total += n * _np_dtype(leaf["dtype"]).itemsize
+    return total
+
+
 def save(path: str, tree: Any) -> None:
     """Persist a pytree of arrays (and scalars) to ``path`` (a dir).
 
@@ -63,49 +145,203 @@ def save(path: str, tree: Any) -> None:
     path removes the old copy before the rename lands, so a concurrent
     reader of that exact path can briefly see it absent — use
     step-numbered dirs (:func:`save_step`), which never overwrite, when
-    another process reads checkpoints live."""
-    import pickle
-    import shutil
+    another process reads checkpoints live.
 
-    tmp = path.rstrip("/") + ".tmp"
-    shutil.rmtree(tmp, ignore_errors=True)  # stale husk from a crash
-    os.makedirs(tmp)
+    Transient ``OSError``\\ s during the write are retried with bounded
+    exponential backoff + jitter (``APEX_TPU_IO_RETRIES`` /
+    ``APEX_TPU_IO_BACKOFF_BASE`` / ``APEX_TPU_IO_BACKOFF_MAX``); every
+    attempt restarts from a fresh tmp dir, so a half-written attempt
+    can never be renamed into place."""
+    import pickle
+
     flat, treedef = jax.tree_util.tree_flatten(jax.device_get(tree))
     arrays = [np.asarray(l) for l in flat]
+    blob = csrc.flatten(arrays)
+    treedef_bytes = pickle.dumps(treedef)
+    chunk = _chunk_bytes()
     manifest = {
         # human-readable only; restore() reads treedef.pkl
         "treedef_repr": str(treedef),
         "leaves": [
             {"shape": list(a.shape), "dtype": a.dtype.name} for a in arrays
         ],
+        "integrity": _integrity_record(
+            {_DATA: blob, _TREEDEF: treedef_bytes}, chunk
+        ),
     }
-    blob = csrc.flatten(arrays)
-    with open(os.path.join(tmp, _DATA), "wb") as f:
-        f.write(blob.tobytes())
-    with open(os.path.join(tmp, _MANIFEST), "w") as f:
-        json.dump(manifest, f)
+    retry_io(
+        lambda: _write_checkpoint_dir(path, manifest, blob, treedef_bytes),
+        describe=f"checkpoint save to {path}",
+    )
+
+
+def _write_checkpoint_dir(path: str, manifest: dict, blob: np.ndarray,
+                          treedef_bytes: bytes) -> None:
+    """One write attempt: fresh tmp dir, three files, atomic rename.
+    Idempotent, so the retry wrapper can call it repeatedly."""
+    import shutil
+
+    tmp = path.rstrip("/") + ".tmp"
+    shutil.rmtree(tmp, ignore_errors=True)  # stale husk from a crash/retry
+    os.makedirs(tmp)
+    with _open(os.path.join(tmp, _DATA), "wb") as f:
+        f.write(memoryview(blob))
     # the structure itself is pickled; this couples a checkpoint to the
     # jax treedef format, so restore with a `target` tree when loading
     # checkpoints across jax upgrades
-    with open(os.path.join(tmp, "treedef.pkl"), "wb") as f:
-        pickle.dump(treedef, f)
+    with _open(os.path.join(tmp, _TREEDEF), "wb") as f:
+        f.write(treedef_bytes)
+    # manifest last: its presence marks the payload files complete
+    with _open(os.path.join(tmp, _MANIFEST), "w") as f:
+        json.dump(manifest, f)
     shutil.rmtree(path, ignore_errors=True)  # overwrite semantics
-    os.rename(tmp, path)
+    _replace(tmp, path)
 
 
-def restore(path: str, target: Optional[Any] = None) -> Any:
+def verify(path: str) -> List[str]:
+    """Integrity-check a checkpoint directory; returns the list of
+    file names that fail (empty == valid).
+
+    Checks, in order: the manifest parses; each checksummed file exists
+    with the recorded byte length; its chunked CRC32s match (read
+    streaming, ``chunk_bytes`` at a time, so multi-GB blobs verify in
+    bounded memory).  Pre-integrity checkpoints (no ``integrity``
+    manifest section) fall back to structural checks: ``data.bin``
+    must match the manifest-computed leaf size and ``treedef.pkl``
+    must exist.
+
+    A manifest that parses as JSON but is structurally mangled (a bit
+    flip inside a key name survives json.load) is reported as a
+    corrupt manifest, not raised — verify's contract is to *name* bad
+    files so the fallback walk can skip them."""
+    try:
+        with open(os.path.join(path, _MANIFEST)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return [_MANIFEST]
+    try:
+        return _verify_against_manifest(path, manifest)
+    except (KeyError, TypeError, AttributeError, ValueError):
+        return [_MANIFEST]  # parseable but structurally corrupt
+
+
+def _verify_against_manifest(path: str, manifest: dict) -> List[str]:
+    bad: List[str] = []
+    integrity = manifest.get("integrity")
+    if integrity is None:  # legacy checkpoint: length/existence only
+        try:
+            actual = os.path.getsize(os.path.join(path, _DATA))
+            if actual != _manifest_leaf_nbytes(manifest):
+                bad.append(_DATA)
+        except OSError:
+            bad.append(_DATA)
+        if not os.path.isfile(os.path.join(path, _TREEDEF)):
+            bad.append(_TREEDEF)
+        return bad
+
+    chunk = int(integrity.get("chunk_bytes", _DEFAULT_CHUNK))
+    for name, rec in integrity["files"].items():
+        fpath = os.path.join(path, name)
+        try:
+            if os.path.getsize(fpath) != rec["nbytes"]:
+                bad.append(name)
+                continue
+            crcs = []
+            with open(fpath, "rb") as f:
+                while True:
+                    piece = f.read(chunk)
+                    if not piece:
+                        break
+                    crcs.append(zlib.crc32(piece) & 0xFFFFFFFF)
+            if (crcs or [0]) != rec["chunks"]:
+                bad.append(name)
+        except OSError:
+            bad.append(name)
+    # the blob must also agree with the leaves it claims to contain
+    if _DATA not in bad:
+        expected = _manifest_leaf_nbytes(manifest)
+        rec = integrity["files"].get(_DATA)
+        if rec is not None and rec["nbytes"] != expected:
+            bad.append(_DATA)
+    return bad
+
+
+def _check_integrity_in_memory(manifest: dict, buffers: Dict[str, Any]
+                               ) -> List[str]:
+    """Replay the manifest's checksums against already-read buffers
+    (no second disk pass).  Returns failing file names."""
+    integrity = manifest.get("integrity")
+    if integrity is None:
+        return []  # legacy checkpoint: nothing to replay
+    chunk = int(integrity.get("chunk_bytes", _DEFAULT_CHUNK))
+    bad = []
+    for name, rec in integrity["files"].items():
+        data = buffers.get(name)
+        if data is None:
+            continue
+        view = memoryview(data)
+        if len(view) != rec["nbytes"] or \
+                _crc_chunks(data, chunk) != rec["chunks"]:
+            bad.append(name)
+    return bad
+
+
+def restore(path: str, target: Optional[Any] = None,
+            verify_integrity: bool = False) -> Any:
     """Load a pytree saved by :func:`save`.  With ``target`` given, the
     stored structure is validated against it and leaves are cast onto
-    the target's dtypes/shapes."""
+    the target's dtypes/shapes.
+
+    The blob's byte length is always validated against the
+    manifest-computed size before ``csrc.unflatten`` touches it;
+    ``verify_integrity=True`` additionally replays the stored checksums
+    against the bytes just read (no second disk pass — resume of a
+    multi-GB checkpoint stays single-read).  Corruption raises
+    :class:`CheckpointCorruptError`."""
     import pickle
 
-    with open(os.path.join(path, _MANIFEST)) as f:
-        manifest = json.load(f)
-    with open(os.path.join(path, "treedef.pkl"), "rb") as f:
-        treedef = pickle.load(f)
+    try:
+        with open(os.path.join(path, _MANIFEST)) as f:
+            manifest = json.load(f)
+    except ValueError as e:  # truncated / garbled JSON
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: unreadable manifest: {e}"
+        ) from e
+    with open(os.path.join(path, _TREEDEF), "rb") as f:
+        treedef_bytes = f.read()
     blob = np.fromfile(os.path.join(path, _DATA), np.uint8)
-    shapes = [tuple(l["shape"]) for l in manifest["leaves"]]
-    dtypes = [_np_dtype(l["dtype"]) for l in manifest["leaves"]]
+    try:
+        if verify_integrity:
+            bad = _check_integrity_in_memory(
+                manifest, {_DATA: blob, _TREEDEF: treedef_bytes}
+            )
+            if bad:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path} failed integrity check: "
+                    f"corrupt file(s) {bad}"
+                )
+        expected = _manifest_leaf_nbytes(manifest)
+        shapes = [tuple(l["shape"]) for l in manifest["leaves"]]
+        dtypes = [_np_dtype(l["dtype"]) for l in manifest["leaves"]]
+    except (KeyError, TypeError, AttributeError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: structurally corrupt manifest: {e!r}"
+        ) from e
+    if blob.nbytes != expected:
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: {_DATA} holds {blob.nbytes} bytes but "
+            f"the manifest's leaves describe {expected} — truncated or "
+            f"partially written checkpoint"
+        )
+    try:
+        treedef = pickle.loads(treedef_bytes)
+    except Exception as e:
+        # corrupt pickle bytes raise nearly anything (UnpicklingError,
+        # EOFError, ValueError, KeyError, ...); all of it means one
+        # thing here, and the fallback walk must be able to catch it
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: unreadable treedef: {e!r}"
+        ) from e
     arrays = csrc.unflatten(blob, shapes, dtypes)
     tree = jax.tree_util.tree_unflatten(treedef, arrays)
     if target is not None:
@@ -161,7 +397,8 @@ def save_async(path: str, tree: Any) -> _PendingSave:
     — then the flatten + file writes run in a daemon thread (both
     release the GIL: the C++ flatten and file I/O).  The training loop
     resumes immediately; a step's save typically overlaps the next
-    steps' device execution entirely.
+    steps' device execution entirely.  The writer thread inherits the
+    same transient-``OSError`` retry policy as the sync path.
 
     Returns a handle; call ``result()`` before depending on the files
     (e.g. before process exit), or :func:`wait_pending_saves` to drain
@@ -247,15 +484,63 @@ def save_step(root: str, step: int, tree: Any) -> str:
     return path
 
 
-def latest_step(root: str) -> Optional[int]:
+def _steps_desc(root: str) -> List[int]:
+    """All ``step_<N>`` directory numbers under ``root``, newest first
+    (``.tmp`` husks and foreign names excluded)."""
     if not os.path.isdir(root):
-        return None
-    steps = [
-        int(m.group(1))
-        for d in os.listdir(root)
-        if (m := re.fullmatch(r"step_(\d+)", d))
-    ]
-    return max(steps) if steps else None
+        return []
+    return sorted(
+        (
+            int(m.group(1))
+            for d in os.listdir(root)
+            if (m := re.fullmatch(r"step_(\d+)", d))
+        ),
+        reverse=True,
+    )
+
+
+def latest_step(root: str) -> Optional[int]:
+    steps = _steps_desc(root)
+    return steps[0] if steps else None
+
+
+def latest_valid_step(root: str) -> Optional[int]:
+    """Newest step directory that passes :func:`verify` (None if no
+    step verifies).  Corrupt newer steps are logged and skipped."""
+    for step in _steps_desc(root):
+        path = os.path.join(root, f"step_{step}")
+        bad = verify(path)
+        if not bad:
+            return step
+        logger.warning(
+            "skipping corrupt checkpoint %s (failed files: %s)", path, bad
+        )
+    return None
+
+
+def restore_latest_valid(root: str, target: Optional[Any] = None
+                         ) -> Tuple[Optional[Any], Optional[int]]:
+    """Restore the newest checkpoint under ``root`` that loads with its
+    checksums intact, walking backwards past corrupt / truncated /
+    incomplete directories.  Returns ``(tree, step)``, or
+    ``(None, None)`` when no checkpoint survives.
+
+    Each candidate is loaded with ``verify_integrity=True`` — the
+    checksums replay against the bytes being restored, so a healthy
+    resume reads every file exactly once.  A structure/shape mismatch
+    against ``target`` still raises: that is a caller bug, not storage
+    corruption."""
+    for step in _steps_desc(root):
+        path = os.path.join(root, f"step_{step}")
+        try:
+            return restore(path, target=target, verify_integrity=True), \
+                step
+        except (CheckpointCorruptError, OSError) as e:
+            logger.warning(
+                "skipping corrupt checkpoint %s (%s); "
+                "falling back to an older step", path, e,
+            )
+    return None, None
 
 
 def restore_step(root: str, target: Optional[Any] = None,

@@ -1,0 +1,65 @@
+"""apex_tpu.resilience — the fault-tolerance layer.
+
+Production TPU training dies to preemption, flaky storage, and silent
+divergence far more often than to kernels; the reference framework's
+only robustness machinery is the amp skip-step patch (reference:
+apex/amp/handle.py:128-154).  This package is the systematic answer,
+spanning checkpoint, amp, and autoresume:
+
+- :mod:`~apex_tpu.resilience.retry` — bounded exponential-backoff +
+  jitter retry for transient storage ``OSError``\\ s (used by the
+  checkpoint sync and async save paths; env-tunable);
+- checkpoint integrity lives in :mod:`apex_tpu.checkpoint` itself
+  (chunked CRC32 manifests, ``verify``, ``restore_latest_valid``) and
+  its :class:`~apex_tpu.checkpoint.CheckpointCorruptError` is
+  re-exported here;
+- :mod:`~apex_tpu.resilience.guard` — :class:`StepGuard`, the
+  divergence monitor that escalates consecutive-nonfinite-step runs
+  warn → rollback (via AutoResume) → :class:`DivergenceError`;
+- :mod:`~apex_tpu.resilience.watchdog` — :class:`Watchdog`, the
+  heartbeat stall detector that dumps all-thread stacks (hung
+  collective / hung storage) and optionally aborts so the scheduler
+  requeues into autoresume;
+- :mod:`~apex_tpu.resilience.faults` — the deterministic
+  fault-injection harness (truncation, bit flips, missing files,
+  fail-the-Nth-write, SIGTERM-mid-save, NaN poisoning) that the test
+  suite drives every one of the above through.
+
+See :doc:`docs/resilience` for the operational guide.
+"""
+
+from apex_tpu.resilience.retry import RetryPolicy, retry_io  # noqa: F401
+from apex_tpu.resilience.guard import (  # noqa: F401
+    DivergenceError,
+    GuardVerdict,
+    StepGuard,
+    locate_nonfinite,
+)
+from apex_tpu.resilience.watchdog import Watchdog, dump_all_stacks  # noqa: F401
+from apex_tpu.resilience import faults  # noqa: F401
+
+
+def __getattr__(name):
+    # CheckpointCorruptError lives in apex_tpu.checkpoint (which imports
+    # resilience.retry); resolve lazily to avoid the import cycle.
+    if name == "CheckpointCorruptError":
+        from apex_tpu.checkpoint import CheckpointCorruptError
+
+        return CheckpointCorruptError
+    raise AttributeError(
+        f"module 'apex_tpu.resilience' has no attribute {name!r}"
+    )
+
+
+__all__ = [
+    "RetryPolicy",
+    "retry_io",
+    "StepGuard",
+    "GuardVerdict",
+    "DivergenceError",
+    "locate_nonfinite",
+    "Watchdog",
+    "dump_all_stacks",
+    "faults",
+    "CheckpointCorruptError",
+]

@@ -1,0 +1,241 @@
+"""Deterministic fault injection for the resilience test surface.
+
+Every recovery path in :mod:`apex_tpu.checkpoint` /
+:class:`~apex_tpu.utils.autoresume.AutoResume` /
+:class:`~apex_tpu.resilience.guard.StepGuard` exists because some
+real-world failure produces it: preemption mid-write, a storage blip, a
+cosmic-ray bit flip, a diverging optimizer.  This module makes each of
+those failures a one-liner so tests *exercise* the recovery code instead
+of asserting it in docstrings:
+
+on-disk corruption (direct, deterministic):
+  :func:`truncate_file`, :func:`flip_bit`, :func:`remove_file`
+
+write-path faults (context managers patching the checkpoint module's
+I/O seams ``checkpoint._open`` / ``checkpoint._replace``):
+  :func:`failing_writes`   — fail the Nth (and following) write-opens
+                             with a transient ``OSError``
+  :func:`failing_renames`  — fail the atomic tmp→final rename (the one
+                             step where a fault could otherwise lose
+                             the previous checkpoint)
+  :func:`sigterm_on_write` — deliver SIGTERM to this process at the
+                             Nth write-open (preemption notice landing
+                             mid-save)
+
+numeric faults:
+  :func:`poison_tree` — NaN/Inf-poison one leaf of a gradient pytree
+
+All injection is count-based and single-process deterministic — no
+randomness, no timing dependence — so a failing resilience test replays
+identically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import threading
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+__all__ = [
+    "truncate_file",
+    "flip_bit",
+    "remove_file",
+    "failing_writes",
+    "failing_renames",
+    "sigterm_on_write",
+    "poison_tree",
+    "InjectedIOError",
+]
+
+
+class InjectedIOError(OSError):
+    """The transient storage failure raised by :func:`failing_writes`
+    (an ``OSError`` subclass so production retry/except paths treat it
+    exactly like the real thing, while tests can match the subtype)."""
+
+
+# --------------------------------------------------------------- on-disk
+def truncate_file(path: str, keep_bytes: Optional[int] = None) -> int:
+    """Truncate ``path`` (default: drop the second half), simulating a
+    writer killed mid-stream or a short read off flaky storage.
+    Returns the new size."""
+    size = os.path.getsize(path)
+    keep = size // 2 if keep_bytes is None else keep_bytes
+    if keep >= size:
+        raise ValueError(
+            f"truncate_file would not shrink {path}: {keep} >= {size}"
+        )
+    os.truncate(path, keep)
+    return keep
+
+
+def flip_bit(path: str, byte_offset: int = 0, bit: int = 0) -> None:
+    """XOR one bit of ``path`` in place — the minimal silent-corruption
+    event a checksum must catch."""
+    size = os.path.getsize(path)
+    if not 0 <= byte_offset < size:
+        raise ValueError(
+            f"byte_offset {byte_offset} outside {path} ({size} bytes)"
+        )
+    with open(path, "r+b") as f:
+        f.seek(byte_offset)
+        b = f.read(1)[0]
+        f.seek(byte_offset)
+        f.write(bytes([b ^ (1 << bit)]))
+
+
+def remove_file(path: str) -> None:
+    """Delete one file from a checkpoint dir (lost object / partial
+    upload)."""
+    os.remove(path)
+
+
+# ----------------------------------------------------------- write seams
+def _is_write_mode(mode: str) -> bool:
+    return any(c in mode for c in "wxa+")
+
+
+class _SeamPatch:
+    """Swap ``checkpoint._open`` for a counting interceptor."""
+
+    def __init__(self, on_write):
+        self._on_write = on_write
+        self._lock = threading.Lock()
+        self.write_count = 0
+
+    def __enter__(self):
+        from apex_tpu import checkpoint as ckpt
+
+        self._ckpt = ckpt
+        self._orig_open = ckpt._open
+
+        def intercepting_open(file, mode="r", *args, **kwargs):
+            if _is_write_mode(mode):
+                with self._lock:
+                    self.write_count += 1
+                    n = self.write_count
+                self._on_write(n, file)
+            return self._orig_open(file, mode, *args, **kwargs)
+
+        ckpt._open = intercepting_open
+        return self
+
+    def __exit__(self, *exc):
+        self._ckpt._open = self._orig_open
+        return False
+
+
+@contextlib.contextmanager
+def failing_writes(fail_first: int = 1, path_substr: Optional[str] = None,
+                   forever: bool = False) -> Iterator[_SeamPatch]:
+    """Within the block, checkpoint write-opens raise
+    :class:`InjectedIOError`: the first ``fail_first`` matching opens
+    fail (then writes succeed — the retry-then-succeed scenario), or
+    every matching open fails with ``forever=True`` (retry-exhausted).
+    ``path_substr`` restricts injection to matching paths.
+
+    The yielded handle exposes ``write_count`` (every checkpoint
+    write-open seen, matching or not) and ``matched_writes`` (a
+    single-element list with the count of ``path_substr``-matching
+    write-opens, i.e. the injector's own counter)."""
+    matched = [0]
+
+    def on_write(n: int, file) -> None:
+        if path_substr is not None and path_substr not in str(file):
+            return
+        matched[0] += 1
+        if forever or matched[0] <= fail_first:
+            raise InjectedIOError(
+                f"injected transient I/O failure "
+                f"(matching write #{matched[0]}) opening {file}"
+            )
+
+    with _SeamPatch(on_write) as patch:
+        patch.matched_writes = matched
+        yield patch
+
+
+@contextlib.contextmanager
+def failing_renames(fail_first: int = 1,
+                    forever: bool = False) -> Iterator[list]:
+    """Within the block, the checkpoint's atomic tmp→final rename
+    (``checkpoint._replace``) raises :class:`InjectedIOError` for the
+    first ``fail_first`` calls (or all of them with ``forever=True``).
+
+    This targets the highest-stakes window in ``save()``: the previous
+    checkpoint at ``path`` is already removed when the rename runs, so
+    recovery here must come from the retry (which rewrites the tmp dir
+    and renames again) or, across processes, from the step-directory
+    fallback walk.  Yields a single-element list holding the number of
+    injected failures so far."""
+    from apex_tpu import checkpoint as ckpt
+
+    orig = ckpt._replace
+    count = [0]
+
+    def flaky_replace(src, dst):
+        if forever or count[0] < fail_first:
+            count[0] += 1
+            raise InjectedIOError(
+                f"injected transient failure renaming {src} -> {dst} "
+                f"(#{count[0]})"
+            )
+        return orig(src, dst)
+
+    ckpt._replace = flaky_replace
+    try:
+        yield count
+    finally:
+        ckpt._replace = orig
+
+
+@contextlib.contextmanager
+def sigterm_on_write(nth: int = 1) -> Iterator[_SeamPatch]:
+    """Deliver SIGTERM to this process at the ``nth`` checkpoint
+    write-open — a preemption notice arriving exactly mid-save.  The
+    write itself proceeds; what happens next is up to the installed
+    handler (e.g. ``AutoResume._on_sigterm`` marks termination and the
+    loop checkpoints at the next boundary)."""
+
+    def on_write(n: int, file) -> None:
+        if n == nth:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with _SeamPatch(on_write) as patch:
+        yield patch
+
+
+# ---------------------------------------------------------------- numeric
+def poison_tree(tree: Any, leaf_index: int = 0, element: int = 0,
+                value: float = float("nan")) -> Any:
+    """Return ``tree`` with one element of one floating leaf replaced by
+    ``value`` (NaN by default, or e.g. ``float("inf")``) — the scripted
+    divergence event for :class:`~apex_tpu.resilience.guard.StepGuard`
+    tests.  Leaves are indexed in ``jax.tree_util`` flatten order over
+    floating-dtype leaves only; non-floating leaves pass through."""
+    import jax
+    import jax.numpy as jnp
+
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    # jnp.issubdtype so bf16 (ml_dtypes) leaves are poisonable too
+    float_positions = [
+        i for i, l in enumerate(flat)
+        if jnp.issubdtype(np.asarray(l).dtype, jnp.floating)
+    ]
+    if not float_positions:
+        raise ValueError("poison_tree: tree has no floating leaves")
+    if not 0 <= leaf_index < len(float_positions):
+        raise ValueError(
+            f"leaf_index {leaf_index} out of range "
+            f"({len(float_positions)} floating leaves)"
+        )
+    pos = float_positions[leaf_index]
+    arr = np.array(np.asarray(flat[pos]), copy=True)
+    arr.reshape(-1)[element] = value
+    flat = list(flat)
+    flat[pos] = arr
+    return jax.tree_util.tree_unflatten(treedef, flat)

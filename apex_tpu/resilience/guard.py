@@ -1,0 +1,234 @@
+"""StepGuard — divergence monitoring and escalation around the scaler.
+
+The reference's entire divergence story is the amp skip-step patch
+(reference: apex/amp/handle.py:128-154): overflowed steps are silently
+skipped and the scale backs off.  That is correct for isolated
+overflows and catastrophically wrong for real divergence — a run whose
+gradients are NaN every step skips forever, pinned at
+``min_loss_scale``, burning its remaining budget producing nothing.
+
+:class:`StepGuard` watches the ``finite`` bit the training loop already
+computes (:meth:`LossScaler.unscale
+<apex_tpu.amp.scaler.LossScaler.unscale>`) and escalates deterministic
+ally on *consecutive* nonfinite steps:
+
+    warn (log, with optional NaN localization)
+      → rollback to the last good checkpoint (via AutoResume)
+        → raise :class:`DivergenceError`
+
+Everything stays off the hot path: :meth:`observe` does pure host-side
+integer bookkeeping on a bool the caller has already synced; gradient
+localization (:func:`locate_nonfinite`) walks the pytree only when a
+bad step is being diagnosed.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["StepGuard", "GuardVerdict", "DivergenceError",
+           "locate_nonfinite"]
+
+logger = logging.getLogger("apex_tpu.resilience")
+
+
+class DivergenceError(RuntimeError):
+    """Training produced nonfinite gradients for ``raise_after``
+    consecutive steps and rollback (if configured) did not help."""
+
+
+class GuardVerdict(NamedTuple):
+    """Result of :meth:`StepGuard.observe` for one step.
+
+    ``action`` is one of ``"ok"``, ``"warn"``, ``"rollback"``;
+    on ``"rollback"``, ``restored_state`` / ``restored_step`` carry
+    what AutoResume recovered (state may be None if no valid
+    checkpoint existed — the caller decides whether to reinit or
+    abort).  ``consecutive_bad`` is the current run length of
+    nonfinite steps, ``at_scale_floor`` whether the loss scale is
+    pinned at its minimum (the classic silent-divergence signature).
+    """
+
+    action: str
+    consecutive_bad: int
+    at_scale_floor: bool = False
+    restored_state: Optional[Any] = None
+    restored_step: Optional[int] = None
+
+
+def locate_nonfinite(tree: Any, max_leaves: int = 8) -> List[str]:
+    """Name the nonfinite leaves of a pytree — ``path (kind xN/M)`` for
+    up to ``max_leaves`` offending leaves, first-flatten-order first.
+
+    Host-side and O(tree) — call it when diagnosing a bad step, not
+    every step."""
+    import jax
+    import jax.numpy as jnp
+
+    out: List[str] = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        arr = np.asarray(leaf)
+        # jnp.issubdtype, not np: bf16 (ml_dtypes) is floating to jax
+        # but not to bare numpy, and bf16 grads are the TPU common case
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        finite = np.isfinite(arr)
+        if finite.all():
+            continue
+        n_nan = int(np.isnan(arr).sum())
+        n_inf = int(np.isinf(arr).sum())
+        kinds = "+".join(
+            k for k, n in (("nan", n_nan), ("inf", n_inf)) if n
+        )
+        out.append(
+            f"{jax.tree_util.keystr(path)} "
+            f"({kinds} x{n_nan + n_inf}/{arr.size})"
+        )
+        if len(out) >= max_leaves:
+            break
+    return out
+
+
+class StepGuard:
+    """Escalating monitor over the train loop's finite/nonfinite signal.
+
+    Parameters
+    ----------
+    scaler:
+        Optional :class:`~apex_tpu.amp.scaler.LossScaler` (anything
+        with a ``min_loss_scale`` attribute).  Enables the
+        scale-at-floor alarm.
+    autoresume:
+        Optional :class:`~apex_tpu.utils.autoresume.AutoResume`.
+        Enables the rollback escalation step.
+    warn_after / rollback_after / raise_after:
+        Consecutive-nonfinite-step thresholds.  ``warn_after`` logs
+        (every bad step from there on), ``rollback_after`` restores the
+        last good checkpoint once per divergence episode (skipped when
+        no ``autoresume`` is given), ``raise_after`` raises
+        :class:`DivergenceError`.  Must be ordered
+        ``warn <= rollback <= raise``.
+    target:
+        Optional pytree passed to ``autoresume.resume(target=...)`` on
+        rollback.
+
+    A finite step resets the consecutive counter and re-arms rollback
+    (a *new* divergence episode may roll back again).
+    """
+
+    def __init__(
+        self,
+        scaler: Optional[Any] = None,
+        autoresume: Optional[Any] = None,
+        warn_after: int = 3,
+        rollback_after: int = 6,
+        raise_after: int = 10,
+        target: Optional[Any] = None,
+    ):
+        if not (1 <= warn_after <= rollback_after <= raise_after):
+            raise ValueError(
+                "need 1 <= warn_after <= rollback_after <= raise_after, "
+                f"got {warn_after}/{rollback_after}/{raise_after}"
+            )
+        self.scaler = scaler
+        self.autoresume = autoresume
+        self.warn_after = warn_after
+        self.rollback_after = rollback_after
+        self.raise_after = raise_after
+        self.target = target
+        self.consecutive_bad = 0
+        self.total_bad = 0
+        self._rolled_back_this_episode = False
+
+    # ------------------------------------------------------------ signal
+    def _scale_at_floor(self, scaler_state: Optional[Any]) -> bool:
+        if self.scaler is None or scaler_state is None:
+            return False
+        floor = getattr(self.scaler, "min_loss_scale", None)
+        if floor is None:
+            return False
+        return float(scaler_state.loss_scale) <= float(floor)
+
+    def observe(
+        self,
+        finite: Any,
+        step: Optional[int] = None,
+        scaler_state: Optional[Any] = None,
+        grads: Optional[Any] = None,
+    ) -> GuardVerdict:
+        """Record one step's finite bit and escalate if needed.
+
+        ``finite`` may be a python bool or a 0-d device array (one
+        host sync, which the skip-step ``jnp.where`` pattern already
+        paid).  ``grads`` (optional) is only inspected on a bad step
+        at/past ``warn_after``, to localize the first nonfinite leaf.
+        """
+        if bool(finite):
+            self.consecutive_bad = 0
+            self._rolled_back_this_episode = False
+            return GuardVerdict("ok", 0)
+
+        self.consecutive_bad += 1
+        self.total_bad += 1
+        at_floor = self._scale_at_floor(scaler_state)
+        where = f" at step {step}" if step is not None else ""
+
+        # rollback is considered BEFORE raise so that
+        # rollback_after == raise_after still gives the configured
+        # rollback one chance; the raise then fires on the next bad step
+        if (
+            self.consecutive_bad >= self.rollback_after
+            and self.autoresume is not None
+            and not self._rolled_back_this_episode
+        ):
+            self._rolled_back_this_episode = True
+            state, rstep = self.autoresume.resume(target=self.target)
+            logger.error(
+                "divergence guard%s: %d consecutive nonfinite steps — "
+                "rolled back to checkpoint step %s",
+                where, self.consecutive_bad, rstep,
+            )
+            return GuardVerdict(
+                "rollback", self.consecutive_bad, at_floor, state, rstep
+            )
+
+        if self.consecutive_bad >= self.raise_after:
+            detail = self._diagnose(grads)
+            raise DivergenceError(
+                f"{self.consecutive_bad} consecutive nonfinite steps"
+                f"{where}"
+                + (" with loss scale pinned at its floor" if at_floor
+                   else "")
+                + (f"; first nonfinite leaves: {detail}" if detail
+                   else "")
+            )
+
+        if self.consecutive_bad >= self.warn_after or at_floor:
+            detail = self._diagnose(grads)
+            logger.warning(
+                "divergence guard%s: %d consecutive nonfinite steps%s%s",
+                where, self.consecutive_bad,
+                " (loss scale pinned at min_loss_scale)" if at_floor
+                else "",
+                f"; nonfinite leaves: {detail}" if detail else "",
+            )
+            return GuardVerdict("warn", self.consecutive_bad, at_floor)
+
+        return GuardVerdict("ok", self.consecutive_bad, at_floor)
+
+    def _diagnose(self, grads: Optional[Any]) -> str:
+        if grads is None:
+            return ""
+        try:
+            return "; ".join(locate_nonfinite(grads))
+        except Exception as e:  # diagnosis must never mask escalation
+            return f"<localization failed: {e!r}>"
+
+    def reset(self) -> None:
+        """Forget all history (e.g. after a manual restart)."""
+        self.consecutive_bad = 0
+        self.total_bad = 0
+        self._rolled_back_this_episode = False

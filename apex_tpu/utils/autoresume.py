@@ -10,6 +10,12 @@ Here it is a real subsystem built on :mod:`apex_tpu.checkpoint`:
   ``keep`` checkpoints, and resumes from the newest one at startup;
 - state is anything pytree-shaped: params, optimizer state, amp
   state-dicts, data-iterator counters.
+
+Resilience semantics (see docs/resilience.md): resume walks back from
+the newest checkpoint past corrupt / truncated / incomplete directories
+(:func:`apex_tpu.checkpoint.restore_latest_valid`), so the process a
+preemption killed mid-write — or a bit-flipped blob — costs one
+checkpoint interval, never the run.
 """
 
 from __future__ import annotations
@@ -32,48 +38,67 @@ class AutoResume:
         keep: int = 2,
         install_sigterm_handler: bool = False,
     ):
+        if keep < 1:
+            # keep=0 would let _gc delete the checkpoint it just wrote
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        if interval_steps < 1:
+            raise ValueError(
+                f"interval_steps must be >= 1, got {interval_steps}"
+            )
         self.root = root
         self.interval_steps = interval_steps
         self.keep = keep
         self._termination_requested = False
+        self._termination_save_done = False
+        self._prev_sigterm = None
         if install_sigterm_handler:
-            signal.signal(signal.SIGTERM, self._on_sigterm)
+            self._prev_sigterm = signal.signal(
+                signal.SIGTERM, self._on_sigterm
+            )
 
     # ------------------------------------------------------------ resume
     def resume(self, target: Optional[Any] = None) -> Tuple[Optional[Any], int]:
-        """Returns (state, step) of the newest checkpoint, or
-        (None, 0) when starting fresh."""
-        step = ckpt.latest_step(self.root)
+        """Returns (state, step) of the newest *valid* checkpoint, or
+        (None, 0) when starting fresh.
+
+        Corrupt or incomplete step directories (failed
+        :func:`apex_tpu.checkpoint.verify`, truncated blob, missing
+        files) are logged and skipped — resume walks back until a
+        checkpoint both verifies and loads."""
+        state, step = ckpt.restore_latest_valid(self.root, target=target)
         if step is None:
             return None, 0
-        return ckpt.restore_step(self.root, target=target, step=step), step
+        return state, step
 
     # -------------------------------------------------------------- save
     def _gc(self) -> None:
-        import re
-
-        # fullmatch, as in checkpoint.latest_step: a crashed atomic
-        # writer leaves a step_<N>.tmp husk that must neither crash the
-        # int() parse nor count as a checkpoint
-        steps = sorted(
-            int(m.group(1))
-            for d in os.listdir(self.root)
-            if (m := re.fullmatch(r"step_(\d+)", d))
-        )
-        for old in steps[: -self.keep]:
+        # ckpt._steps_desc excludes .tmp husks from crashed atomic
+        # writers, so GC can neither crash on them nor count them
+        for old in ckpt._steps_desc(self.root)[self.keep:]:
             shutil.rmtree(
                 os.path.join(self.root, f"step_{old}"), ignore_errors=True
             )
 
     def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
         """Save when the interval elapses or termination was requested.
-        Returns True if a checkpoint was written."""
-        due = force or self._termination_requested or (
+        Returns True if a checkpoint was written.
+
+        A termination request triggers exactly ONE forced save (the
+        flag is consumed once its checkpoint lands); subsequent steps
+        fall back to the normal interval schedule instead of re-saving
+        and GC-churning every step.  ``termination_requested()`` keeps
+        reporting True so the loop still exits at its boundary."""
+        termination_due = (
+            self._termination_requested and not self._termination_save_done
+        )
+        due = force or termination_due or (
             step > 0 and step % self.interval_steps == 0
         )
         if not due:
             return False
         ckpt.save_step(self.root, step, state)
+        if termination_due:
+            self._termination_save_done = True
         self._gc()
         return True
 
@@ -82,6 +107,12 @@ class AutoResume:
         # mark only; the training loop saves at the next step boundary
         # (async-safe: no I/O in the handler)
         self._termination_requested = True
+        self._termination_save_done = False
+        prev = self._prev_sigterm
+        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+            # chain: whoever installed a handler before us (cluster
+            # agent, profiler flusher) still gets the notice
+            prev(signum, frame)
 
     def termination_requested(self) -> bool:
         """(the reference's AutoResume.termination_requested() shape,
@@ -90,3 +121,4 @@ class AutoResume:
 
     def request_termination(self) -> None:
         self._termination_requested = True
+        self._termination_save_done = False

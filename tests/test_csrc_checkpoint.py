@@ -121,6 +121,55 @@ class TestCheckpoint:
         )
 
 
+class TestIntegrityRoundtrip:
+    """Checksum/verify integration with the core save/restore flow
+    (the corruption-detection cases live in tests/test_resilience.py)."""
+
+    def test_save_verify_restore_roundtrip(self, tmp_path):
+        tree = {
+            "params": {"w": jnp.arange(48.0).reshape(6, 8),
+                       "b": jnp.ones((8,), jnp.bfloat16)},
+            "step": jnp.int32(11),
+        }
+        path = str(tmp_path / "c")
+        ckpt.save(path, tree)
+        assert ckpt.verify(path) == []
+        out = ckpt.restore(path, verify_integrity=True)
+        for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(tree)):
+            assert np.asarray(a).dtype == np.asarray(b).dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_multi_chunk_checksums_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_CKPT_CHUNK_BYTES", "32")
+        path = str(tmp_path / "c")
+        tree = {"w": jnp.arange(256.0)}  # 1 KiB blob → 32 chunks
+        ckpt.save(path, tree)
+        assert ckpt.verify(path) == []
+        out = ckpt.restore(path, verify_integrity=True)
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.arange(256.0, dtype=np.float32))
+
+    def test_async_save_records_verifiable_checksums(self, tmp_path):
+        h = ckpt.save_async(str(tmp_path / "a"),
+                            {"w": jnp.full((16,), 2.5)})
+        h.result(timeout=30)
+        assert ckpt.verify(str(tmp_path / "a")) == []
+
+    def test_restore_latest_valid_on_healthy_root(self, tmp_path):
+        for step in (3, 6):
+            ckpt.save_step(str(tmp_path), step,
+                           {"w": jnp.full((4,), float(step))})
+        tree, step = ckpt.restore_latest_valid(str(tmp_path))
+        assert step == 6
+        np.testing.assert_array_equal(np.asarray(tree["w"]), 6.0)
+
+    def test_empty_tree_verifies(self, tmp_path):
+        path = str(tmp_path / "empty")
+        ckpt.save(path, {})
+        assert ckpt.verify(path) == []
+        assert ckpt.restore(path, verify_integrity=True) == {}
+
+
 class TestAsyncSave:
     def test_async_roundtrip_bitwise(self, tmp_path):
         tree = {

@@ -1,0 +1,59 @@
+"""Slow-tier process-level resilience drills.
+
+These cross a real process boundary — ``kill -9`` mid-``save_async``,
+SIGABRT from the watchdog — which no in-process mock can exercise.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fault_drill_kill9_mid_async_save(tmp_path):
+    """Parent kills the toy trainer mid-save_async; the next life must
+    resume from the last valid step with verified checksums (the drill
+    asserts all of it and exits nonzero on any miss)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "fault_drill.py"),
+         "--root", str(tmp_path / "drill"), "--steps", "6",
+         "--kill-after-saves", "2", "--write-delay", "0.08"],
+        capture_output=True, text=True, timeout=560,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, (
+        f"drill failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert "drill PASSED" in proc.stdout
+
+
+def test_watchdog_abort_kills_stalled_process(tmp_path):
+    """abort=True: a stalled loop dies by SIGABRT (so the scheduler
+    requeues it) instead of hanging forever."""
+    script = """
+import time
+from apex_tpu.resilience import Watchdog
+
+wd = Watchdog(deadline_s=0.3, poll_s=0.05, abort=True).start()
+print("STALLING", flush=True)
+time.sleep(30)   # never beats; the watchdog must kill us long before
+print("UNREACHABLE", flush=True)
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PYTHONPATH=_REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")),
+    )
+    assert proc.returncode == -signal.SIGABRT, (
+        f"expected SIGABRT exit, got {proc.returncode}:\n{proc.stderr}"
+    )
+    assert "UNREACHABLE" not in proc.stdout
+    assert "watchdog stack dump" in proc.stderr

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Fault drill: SIGKILL a training process mid-``save_async`` and prove
+the parent's next life resumes from the last valid checkpoint.
+
+This is the resilience subsystem's end-to-end rehearsal of the failure
+that actually takes down production runs — preemption landing while the
+async checkpoint writer is mid-file — exercised with a real ``kill -9``
+(no in-process mocking survives one) across a real process boundary:
+
+1. spawn a toy train loop (``--child`` mode) that checkpoints every
+   step via :func:`apex_tpu.checkpoint.save_async`, with each file
+   write slowed by ``--write-delay`` so "mid-save" is a wide,
+   deterministic target;
+2. wait until ``--kill-after-saves`` checkpoints have landed, then
+   SIGKILL the child the moment it announces the next save;
+3. verify every surviving ``step_<N>`` directory passes
+   ``checkpoint.verify`` (checksums intact), the half-written step left
+   only a ``.tmp`` husk, and ``restore_latest_valid`` returns the last
+   completed step;
+4. re-spawn the child, which must resume from exactly that step and
+   finish the run.
+
+Exit code 0 = drill passed.  Run it standalone::
+
+    python tools/fault_drill.py --root /tmp/drill --write-delay 0.05
+
+or via the slow test tier (``tests/test_fault_drill.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _log(msg: str) -> None:
+    print(f"[fault-drill] {msg}", flush=True)
+
+
+# ------------------------------------------------------------------ child
+def run_child(root: str, steps: int, write_delay: float) -> int:
+    """Toy train loop: resume, then one checkpoint per step, announcing
+    SAVING/SAVED so the parent can time its kill."""
+    import jax.numpy as jnp
+
+    from apex_tpu import checkpoint as ckpt
+    from apex_tpu.utils.autoresume import AutoResume
+
+    if write_delay > 0:
+        # stretch each file write so SIGKILL reliably lands mid-save
+        orig_open = ckpt._open
+
+        def slow_open(file, mode="r", *args, **kwargs):
+            if any(c in mode for c in "wxa"):
+                time.sleep(write_delay)
+            return orig_open(file, mode, *args, **kwargs)
+
+        ckpt._open = slow_open
+
+    ar = AutoResume(root, interval_steps=1, keep=steps + 1)
+    state, start = ar.resume()
+    print(f"RESUMED {start}", flush=True)
+    for step in range(start + 1, steps + 1):
+        state = {"w": jnp.full((256, 256), float(step), jnp.float32),
+                 "step": jnp.int32(step)}
+        print(f"SAVING {step}", flush=True)
+        handle = ckpt.save_async(os.path.join(root, f"step_{step}"), state)
+        handle.result(timeout=120)
+        print(f"SAVED {step}", flush=True)
+    print("DONE", flush=True)
+    return 0
+
+
+# ----------------------------------------------------------------- parent
+def _spawn_child(root: str, steps: int, write_delay: float):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         "--root", root, "--steps", str(steps),
+         "--write-delay", str(write_delay)],
+        stdout=subprocess.PIPE, text=True, bufsize=1, env=env,
+    )
+
+
+def run_drill(root: str, steps: int, kill_after_saves: int,
+              write_delay: float) -> int:
+    from apex_tpu import checkpoint as ckpt
+
+    if os.path.isdir(root):
+        shutil.rmtree(root)
+    os.makedirs(root)
+
+    # ---- leg 1: train, then kill -9 mid-save ------------------------
+    child = _spawn_child(root, steps, write_delay)
+    last_saved = None
+    killed_step = None
+    try:
+        for line in child.stdout:
+            line = line.strip()
+            if m := re.fullmatch(r"SAVED (\d+)", line):
+                last_saved = int(m.group(1))
+            elif (m := re.fullmatch(r"SAVING (\d+)", line)) and \
+                    last_saved is not None and \
+                    last_saved >= kill_after_saves:
+                killed_step = int(m.group(1))
+                time.sleep(write_delay * 1.5)  # land inside the writes
+                _log(f"SIGKILL at save of step {killed_step} "
+                     f"(last completed: {last_saved})")
+                child.kill()
+                break
+        else:
+            _log("FAIL: child finished before the kill window")
+            return 1
+    finally:
+        child.wait(timeout=60)
+        child.stdout.close()
+
+    # ---- verify what the kill left behind ---------------------------
+    entries = sorted(os.listdir(root))
+    _log(f"post-kill checkpoint root: {entries}")
+    complete = [d for d in entries if re.fullmatch(r"step_(\d+)", d)]
+    for d in complete:
+        bad = ckpt.verify(os.path.join(root, d))
+        if bad:
+            _log(f"FAIL: surviving checkpoint {d} fails verify: {bad}")
+            return 1
+    _log(f"all {len(complete)} surviving checkpoints verify clean")
+
+    tree, step = ckpt.restore_latest_valid(root)
+    # on a loaded host the SIGKILL can race past the atomic rename: the
+    # "interrupted" save may actually have completed, which is also a
+    # correct outcome — what's never acceptable is anything else
+    if step not in (last_saved, killed_step):
+        _log(f"FAIL: restore_latest_valid returned step {step}, "
+             f"expected {last_saved} (or {killed_step} if the kill "
+             f"lost the race to the rename)")
+        return 1
+    if step == killed_step:
+        _log(f"note: kill landed after step {killed_step}'s rename — "
+             f"the save completed; resuming from it is correct")
+    import numpy as np
+
+    if not (np.asarray(tree["w"]) == float(step)).all():
+        _log(f"FAIL: restored payload does not match step {step}")
+        return 1
+    _log(f"restore_latest_valid -> step {step} with intact payload")
+    resume_from = step
+
+    # ---- leg 2: resurrection must resume from that step -------------
+    child = _spawn_child(root, steps, 0.0)
+    out, _ = child.communicate(timeout=300)
+    if child.returncode != 0:
+        _log(f"FAIL: resumed child exited {child.returncode}")
+        return 1
+    m = re.search(r"^RESUMED (\d+)$", out, re.M)
+    if m is None or int(m.group(1)) != resume_from:
+        _log(f"FAIL: resumed child reported RESUMED "
+             f"{m.group(1) if m else '<none>'}, expected {resume_from}")
+        return 1
+    if not re.search(r"^DONE$", out, re.M):
+        _log("FAIL: resumed child did not finish the run")
+        return 1
+    _log(f"resumed from step {resume_from} and completed {steps} steps — "
+         f"drill PASSED")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--root", default="/tmp/apex_tpu_fault_drill")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--kill-after-saves", type=int, default=2,
+                    help="completed checkpoints required before SIGKILL")
+    ap.add_argument("--write-delay", type=float, default=0.05,
+                    help="per-file write slowdown in the child (s)")
+    ap.add_argument("--child", action="store_true",
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.kill_after_saves < 1:
+        ap.error("--kill-after-saves must be >= 1")
+    if args.child:
+        return run_child(args.root, args.steps, args.write_delay)
+    return run_drill(args.root, args.steps, args.kill_after_saves,
+                     args.write_delay)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
