@@ -142,10 +142,12 @@ def save(path: str, tree: Any) -> None:
     can never observe a half-written checkpoint — essential now that
     :func:`save_async` stretches the write over whole training steps.
     Scope: the guarantee is fresh-or-complete.  OVERWRITING an existing
-    path removes the old copy before the rename lands, so a concurrent
-    reader of that exact path can briefly see it absent — use
-    step-numbered dirs (:func:`save_step`), which never overwrite, when
-    another process reads checkpoints live.
+    path parks the old copy at ``path + ".old"`` until the new rename
+    lands (it is restored if the rename fails, so even retry exhaustion
+    cannot lose the previous checkpoint), but a concurrent reader of
+    that exact path can still briefly see it absent between the two
+    renames — use step-numbered dirs (:func:`save_step`), which never
+    overwrite, when another process reads checkpoints live.
 
     Transient ``OSError``\\ s during the write are retried with bounded
     exponential backoff + jitter (``APEX_TPU_IO_RETRIES`` /
@@ -178,10 +180,17 @@ def save(path: str, tree: Any) -> None:
 def _write_checkpoint_dir(path: str, manifest: dict, blob: np.ndarray,
                           treedef_bytes: bytes) -> None:
     """One write attempt: fresh tmp dir, three files, atomic rename.
-    Idempotent, so the retry wrapper can call it repeatedly."""
+    Idempotent, so the retry wrapper can call it repeatedly.
+
+    Overwrite semantics never destroy the previous checkpoint before
+    the new one lands: the old dir is parked at ``path + ".old"``,
+    restored if the tmp→final rename fails (so retry exhaustion leaves
+    the previous checkpoint in place, not a hole), and removed only
+    after the new checkpoint is visible."""
     import shutil
 
     tmp = path.rstrip("/") + ".tmp"
+    old = path.rstrip("/") + ".old"
     shutil.rmtree(tmp, ignore_errors=True)  # stale husk from a crash/retry
     os.makedirs(tmp)
     with _open(os.path.join(tmp, _DATA), "wb") as f:
@@ -194,38 +203,109 @@ def _write_checkpoint_dir(path: str, manifest: dict, blob: np.ndarray,
     # manifest last: its presence marks the payload files complete
     with _open(os.path.join(tmp, _MANIFEST), "w") as f:
         json.dump(manifest, f)
-    shutil.rmtree(path, ignore_errors=True)  # overwrite semantics
-    _replace(tmp, path)
+    if not os.path.isdir(path) and os.path.isdir(old):
+        # a previous attempt (or process) parked the old checkpoint and
+        # died before restoring it: bring it back rather than delete it
+        os.rename(old, path)
+    else:
+        shutil.rmtree(old, ignore_errors=True)  # stale husk
+    moved_aside = False
+    # only a directory is a previous checkpoint; a non-dir at `path` is
+    # a caller mistake and the rename below fails loudly on it
+    if os.path.isdir(path):
+        os.rename(path, old)
+        moved_aside = True
+    try:
+        _replace(tmp, path)
+    except BaseException:
+        if moved_aside:
+            try:
+                os.rename(old, path)  # put the previous checkpoint back
+            except OSError:
+                logger.exception(
+                    "could not restore previous checkpoint %s after a "
+                    "failed rename", path,
+                )
+        raise
+    if moved_aside:
+        shutil.rmtree(old, ignore_errors=True)
 
 
-def verify(path: str) -> List[str]:
+def verify(path: str, *, deep: bool = True,
+           raise_transient: bool = False) -> List[str]:
     """Integrity-check a checkpoint directory; returns the list of
     file names that fail (empty == valid).
 
     Checks, in order: the manifest parses; each checksummed file exists
     with the recorded byte length; its chunked CRC32s match (read
     streaming, ``chunk_bytes`` at a time, so multi-GB blobs verify in
-    bounded memory).  Pre-integrity checkpoints (no ``integrity``
-    manifest section) fall back to structural checks: ``data.bin``
-    must match the manifest-computed leaf size and ``treedef.pkl``
-    must exist.
+    bounded memory); the ``integrity.files`` section covers BOTH
+    payload files (``data.bin``, ``treedef.pkl``) — a parseable
+    manifest that lost an integrity entry reports that file corrupt
+    rather than silently skipping its checksum.  Pre-integrity
+    checkpoints (no ``integrity`` manifest section) fall back to
+    structural checks: ``data.bin`` must match the manifest-computed
+    leaf size and ``treedef.pkl`` must exist.
 
     A manifest that parses as JSON but is structurally mangled (a bit
     flip inside a key name survives json.load) is reported as a
     corrupt manifest, not raised — verify's contract is to *name* bad
-    files so the fallback walk can skip them."""
+    files so the fallback walk can skip them.
+
+    ``deep=False`` skips the CRC streaming and keeps only the
+    stat-level checks (files exist with the recorded byte lengths,
+    integrity coverage, leaf-size cross-check) — microseconds instead
+    of a full read; it catches truncation/missing/incomplete dirs but
+    not same-length bit flips.  ``raise_transient=True`` re-raises
+    ``OSError``\\ s that do NOT mean "file is missing"
+    (``FileNotFoundError`` / ``NotADirectoryError`` still report the
+    file corrupt) — callers about to take a destructive action on a
+    "corrupt" verdict use this so one storage blip cannot condemn a
+    healthy checkpoint."""
+    _recover_parked(path)
     try:
         with open(os.path.join(path, _MANIFEST)) as f:
             manifest = json.load(f)
-    except (OSError, ValueError):
+    except OSError as e:
+        _maybe_reraise_transient(e, raise_transient)
+        return [_MANIFEST]
+    except ValueError:
         return [_MANIFEST]
     try:
-        return _verify_against_manifest(path, manifest)
+        return _verify_against_manifest(
+            path, manifest, deep=deep, raise_transient=raise_transient
+        )
     except (KeyError, TypeError, AttributeError, ValueError):
         return [_MANIFEST]  # parseable but structurally corrupt
 
 
-def _verify_against_manifest(path: str, manifest: dict) -> List[str]:
+def _maybe_reraise_transient(e: OSError, raise_transient: bool) -> None:
+    if raise_transient and not isinstance(
+            e, (FileNotFoundError, NotADirectoryError)):
+        raise e
+
+
+def _recover_parked(path: str) -> None:
+    """If ``path`` is absent but an overwrite-mode save crashed between
+    parking the previous checkpoint at ``path + ".old"`` and landing
+    the new rename, bring the parked copy back — the read paths heal
+    the crash window instead of waiting for the next save to run the
+    same recovery."""
+    old = path.rstrip("/") + ".old"
+    if not os.path.isdir(path) and os.path.isdir(old):
+        try:
+            os.rename(old, path)
+            logger.warning(
+                "recovered checkpoint %s from the %s parked by a "
+                "crashed overwrite save", path, old,
+            )
+        except OSError:
+            pass  # lost a race with a concurrent writer/reader
+
+
+def _verify_against_manifest(path: str, manifest: dict, *,
+                             deep: bool = True,
+                             raise_transient: bool = False) -> List[str]:
     bad: List[str] = []
     integrity = manifest.get("integrity")
     if integrity is None:  # legacy checkpoint: length/existence only
@@ -233,7 +313,8 @@ def _verify_against_manifest(path: str, manifest: dict) -> List[str]:
             actual = os.path.getsize(os.path.join(path, _DATA))
             if actual != _manifest_leaf_nbytes(manifest):
                 bad.append(_DATA)
-        except OSError:
+        except OSError as e:
+            _maybe_reraise_transient(e, raise_transient)
             bad.append(_DATA)
         if not os.path.isfile(os.path.join(path, _TREEDEF)):
             bad.append(_TREEDEF)
@@ -246,6 +327,8 @@ def _verify_against_manifest(path: str, manifest: dict) -> List[str]:
             if os.path.getsize(fpath) != rec["nbytes"]:
                 bad.append(name)
                 continue
+            if not deep:
+                continue
             crcs = []
             with open(fpath, "rb") as f:
                 while True:
@@ -255,13 +338,18 @@ def _verify_against_manifest(path: str, manifest: dict) -> List[str]:
                     crcs.append(zlib.crc32(piece) & 0xFFFFFFFF)
             if (crcs or [0]) != rec["chunks"]:
                 bad.append(name)
-        except OSError:
+        except OSError as e:
+            _maybe_reraise_transient(e, raise_transient)
             bad.append(name)
+    # a corrupted-but-parseable manifest can LOSE an integrity entry;
+    # an unchecksummed payload file must read as corrupt, not clean
+    for required in (_DATA, _TREEDEF):
+        if required not in integrity["files"]:
+            bad.append(required)
     # the blob must also agree with the leaves it claims to contain
     if _DATA not in bad:
         expected = _manifest_leaf_nbytes(manifest)
-        rec = integrity["files"].get(_DATA)
-        if rec is not None and rec["nbytes"] != expected:
+        if integrity["files"][_DATA]["nbytes"] != expected:
             bad.append(_DATA)
     return bad
 
@@ -283,6 +371,11 @@ def _check_integrity_in_memory(manifest: dict, buffers: Dict[str, Any]
         if len(view) != rec["nbytes"] or \
                 _crc_chunks(data, chunk) != rec["chunks"]:
             bad.append(name)
+    # same coverage rule as verify(): a manifest whose integrity
+    # section lost a payload entry cannot vouch for that file
+    for name in buffers:
+        if name not in integrity["files"]:
+            bad.append(name)
     return bad
 
 
@@ -300,6 +393,7 @@ def restore(path: str, target: Optional[Any] = None,
     :class:`CheckpointCorruptError`."""
     import pickle
 
+    _recover_parked(path)
     try:
         with open(os.path.join(path, _MANIFEST)) as f:
             manifest = json.load(f)
@@ -486,7 +580,7 @@ def save_step(root: str, step: int, tree: Any) -> str:
 
 def _steps_desc(root: str) -> List[int]:
     """All ``step_<N>`` directory numbers under ``root``, newest first
-    (``.tmp`` husks and foreign names excluded)."""
+    (``.tmp``/``.old`` husks and foreign names excluded)."""
     if not os.path.isdir(root):
         return []
     return sorted(

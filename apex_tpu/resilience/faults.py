@@ -166,12 +166,13 @@ def failing_renames(fail_first: int = 1,
     (``checkpoint._replace``) raises :class:`InjectedIOError` for the
     first ``fail_first`` calls (or all of them with ``forever=True``).
 
-    This targets the highest-stakes window in ``save()``: the previous
-    checkpoint at ``path`` is already removed when the rename runs, so
-    recovery here must come from the retry (which rewrites the tmp dir
-    and renames again) or, across processes, from the step-directory
-    fallback walk.  Yields a single-element list holding the number of
-    injected failures so far."""
+    This targets the highest-stakes window in ``save()``: when the
+    rename runs, the previous checkpoint at ``path`` is parked at
+    ``path + ".old"`` — a failed rename must restore it (so even retry
+    exhaustion leaves the old checkpoint in place), and a retried
+    rename rebuilds the tmp dir and lands the new one.  Yields a
+    single-element list holding the number of injected failures so
+    far."""
     from apex_tpu import checkpoint as ckpt
 
     orig = ckpt._replace

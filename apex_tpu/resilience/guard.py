@@ -108,9 +108,12 @@ class StepGuard:
         Consecutive-nonfinite-step thresholds.  ``warn_after`` logs
         (every bad step from there on), ``rollback_after`` restores the
         last good checkpoint once per divergence episode (skipped when
-        no ``autoresume`` is given), ``raise_after`` raises
-        :class:`DivergenceError`.  Must be ordered
-        ``warn <= rollback <= raise``.
+        no ``autoresume`` is given) — checksum-valid snapshots of
+        already-nonfinite state are discarded and the walk continues,
+        and step dirs newer than the restored step are removed so the
+        rollback survives a crash (see :meth:`_rollback`) —
+        ``raise_after`` raises :class:`DivergenceError`.  Must be
+        ordered ``warn <= rollback <= raise``.
     target:
         Optional pytree passed to ``autoresume.resume(target=...)`` on
         rollback.
@@ -185,7 +188,7 @@ class StepGuard:
             and not self._rolled_back_this_episode
         ):
             self._rolled_back_this_episode = True
-            state, rstep = self.autoresume.resume(target=self.target)
+            state, rstep = self._rollback()
             logger.error(
                 "divergence guard%s: %d consecutive nonfinite steps — "
                 "rolled back to checkpoint step %s",
@@ -218,6 +221,72 @@ class StepGuard:
             return GuardVerdict("warn", self.consecutive_bad, at_floor)
 
         return GuardVerdict("ok", self.consecutive_bad, at_floor)
+
+    def _rollback(self) -> Tuple[Optional[Any], Optional[int]]:
+        """Restore the newest checkpoint that is both checksum-valid AND
+        finite, then make the rollback durable on disk.
+
+        A divergence that outlived a save interval leaves checksum-valid
+        snapshots of the already-NaN state on disk; resuming into one
+        would make the rollback a no-op, so any restored state with
+        nonfinite leaves is discarded and the walk continues.  Once a
+        good state is found, step directories newer than it are
+        quarantined (renamed to ``step_<N>.discarded``, invisible to
+        resume but preserved for forensics) — otherwise a crash right
+        after rollback resumes from the newest (diverged) checkpoint,
+        and post-rollback saves at lower step numbers get GC'd in favor
+        of those stale dirs.
+
+        The discards go through ``AutoResume.discard_step`` /
+        ``discard_steps_after`` when the autoresume object has them
+        (duck-typed stand-ins without the methods just skip the disk
+        cleanup)."""
+        ar = self.autoresume
+        discard_one = getattr(ar, "discard_step", None)
+        discard_after = getattr(ar, "discard_steps_after", None)
+        prev_rstep = None
+        while True:
+            state, rstep = ar.resume(target=self.target)
+            if state is None:
+                return None, rstep
+            bad_leaves = locate_nonfinite(state, max_leaves=1)
+            if not bad_leaves:
+                if discard_after is not None:
+                    try:
+                        discard_after(rstep)
+                    except OSError as e:
+                        # good state is already in hand; a storage blip
+                        # during cleanup must not crash the rollback
+                        logger.error(
+                            "could not discard checkpoints newer than "
+                            "rollback point %s (%s); rollback is not "
+                            "crash-durable", rstep, e,
+                        )
+                return state, rstep
+            logger.warning(
+                "checkpoint step %s is checksum-valid but already "
+                "diverged (%s); discarding and walking back further",
+                rstep, bad_leaves[0],
+            )
+            if discard_one is None or rstep == prev_rstep:
+                # cannot remove it (no discard method, or the discard
+                # silently failed and resume handed the same poisoned
+                # step back): return it as-is rather than loop forever
+                if rstep == prev_rstep:
+                    logger.error(
+                        "discard of diverged checkpoint step %s had no "
+                        "effect; returning its state anyway", rstep,
+                    )
+                return state, rstep
+            prev_rstep = rstep
+            try:
+                discard_one(rstep)
+            except OSError as e:
+                logger.error(
+                    "could not discard diverged checkpoint step %s "
+                    "(%s); returning its state anyway", rstep, e,
+                )
+                return state, rstep
 
     def _diagnose(self, grads: Optional[Any]) -> str:
         if grads is None:

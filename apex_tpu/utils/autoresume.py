@@ -20,14 +20,17 @@ checkpoint interval, never the run.
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import signal
-from typing import Any, Optional, Tuple
+from typing import Any, Optional, Set, Tuple
 
 from apex_tpu import checkpoint as ckpt
 
 __all__ = ["AutoResume"]
+
+logger = logging.getLogger("apex_tpu.autoresume")
 
 
 class AutoResume:
@@ -50,6 +53,10 @@ class AutoResume:
         self.keep = keep
         self._termination_requested = False
         self._termination_save_done = False
+        # steps known to hold a valid checkpoint: every step this
+        # process saved or verified.  Lets _gc be validity-aware
+        # without re-checksumming every kept checkpoint on every save.
+        self._known_valid: Set[int] = set()
         self._prev_sigterm = None
         if install_sigterm_handler:
             self._prev_sigterm = signal.signal(
@@ -68,16 +75,67 @@ class AutoResume:
         state, step = ckpt.restore_latest_valid(self.root, target=target)
         if step is None:
             return None, 0
+        self._known_valid.add(step)
         return state, step
 
     # -------------------------------------------------------------- save
-    def _gc(self) -> None:
-        # ckpt._steps_desc excludes .tmp husks from crashed atomic
-        # writers, so GC can neither crash on them nor count them
-        for old in ckpt._steps_desc(self.root)[self.keep:]:
-            shutil.rmtree(
-                os.path.join(self.root, f"step_{old}"), ignore_errors=True
-            )
+    def _step_is_valid(self, step: int, path: str, deep: bool) -> bool:
+        """Whether a step dir may count toward ``keep``.  Raises
+        ``OSError`` on a transient read failure (missing files still
+        read as invalid) — the caller must not destroy a checkpoint it
+        could not actually inspect."""
+        if step in self._known_valid:
+            return True
+        bad = ckpt.verify(path, deep=deep, raise_transient=True)
+        if bad:
+            return False
+        self._known_valid.add(step)
+        return True
+
+    def _gc(self, just_saved: Optional[int] = None) -> None:
+        """Keep the ``keep`` newest *valid* checkpoints; remove the rest.
+
+        Validity-aware so resuming past corrupt newer steps can never
+        end with GC deleting the valid checkpoint it just wrote in
+        favor of corrupt higher-numbered dirs: corrupt dirs don't count
+        toward ``keep`` and are themselves removed (a visible step dir
+        failing :func:`apex_tpu.checkpoint.verify` is genuinely corrupt
+        — in-flight writers are ``.tmp`` husks, which
+        ``ckpt._steps_desc`` already excludes).  ``just_saved`` is kept
+        unconditionally.
+
+        Cost control: dirs NEWER than ``just_saved`` (the dangerous
+        case — exactly what a fallback past corrupt steps leaves
+        behind, and normally none exist) get the full checksum verify;
+        older uncached dirs get the stat-level check (``deep=False``),
+        so the save path never streams multi-GB blobs.  A transient
+        read error during verification leaves the dir in place,
+        uncounted — one storage blip must not delete a healthy
+        checkpoint."""
+        kept = 0
+        for step in ckpt._steps_desc(self.root):
+            path = os.path.join(self.root, f"step_{step}")
+            if kept < self.keep:
+                deep = just_saved is None or step > just_saved
+                try:
+                    valid = self._step_is_valid(step, path, deep)
+                except OSError as e:
+                    logger.warning(
+                        "cannot verify checkpoint %s (%s); leaving it "
+                        "in place unjudged", path, e,
+                    )
+                    continue  # retained, but does not count toward keep
+                if valid:
+                    kept += 1
+                    continue
+                logger.warning(
+                    "autoresume GC removing corrupt checkpoint %s", path
+                )
+            elif step == just_saved:  # invariant backstop: never delete it
+                kept += 1
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            self._known_valid.discard(step)
 
     def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
         """Save when the interval elapses or termination was requested.
@@ -97,10 +155,47 @@ class AutoResume:
         if not due:
             return False
         ckpt.save_step(self.root, step, state)
+        self._known_valid.add(step)
         if termination_due:
             self._termination_save_done = True
-        self._gc()
+        self._gc(just_saved=step)
         return True
+
+    # ---------------------------------------------------------- discard
+    def discard_step(self, step: int) -> None:
+        """Quarantine one step directory (e.g. a checksum-valid snapshot
+        of an already-diverged state that rollback must not resume
+        into): renamed to ``step_<N>.discarded`` (``.discarded.<k>`` if
+        that name is taken — a repeated divergence at the same step
+        must not overwrite the earlier forensic copy), which resume/GC
+        never see, rather than deleted — training history stays on disk
+        for forensics even if every checkpoint turns out to be
+        poisoned."""
+        src = os.path.join(self.root, f"step_{step}")
+        dst = src + ".discarded"
+        k = 1
+        while os.path.exists(dst):
+            dst = src + f".discarded.{k}"
+            k += 1
+        try:
+            os.rename(src, dst)
+        except FileNotFoundError:
+            pass
+        self._known_valid.discard(step)
+
+    def discard_steps_after(self, step: int) -> None:
+        """Quarantine every step directory numbered above ``step``,
+        making a rollback durable: a crash right after it resumes from
+        ``step`` (or older), not from a stale newer checkpoint, and
+        later saves at lower step numbers are never GC'd in favor of
+        those dirs."""
+        for s in ckpt._steps_desc(self.root):
+            if s > step:
+                logger.warning(
+                    "discarding checkpoint step_%d (newer than rollback "
+                    "point %d)", s, step,
+                )
+                self.discard_step(s)
 
     # --------------------------------------------------- failure signal
     def _on_sigterm(self, signum, frame):

@@ -185,6 +185,24 @@ class TestIntegrity:
         _, step = AutoResume(str(tmp_path)).resume()
         assert step == 1
 
+    def test_verify_requires_integrity_coverage_of_payload_files(
+            self, tmp_path):
+        """A parseable manifest whose integrity section LOST its
+        data.bin entry must read as corrupt, not clean — the blob would
+        otherwise go unchecksummed and a bit flip would pass verify."""
+        _save_steps(tmp_path, (1, 2))
+        mpath = str(tmp_path / "step_2" / "manifest.json")
+        with open(mpath) as f:
+            manifest = json.load(f)
+        del manifest["integrity"]["files"]["data.bin"]
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+        assert ckpt.verify(str(tmp_path / "step_2")) == ["data.bin"]
+        with pytest.raises(CheckpointCorruptError, match="data.bin"):
+            ckpt.restore(str(tmp_path / "step_2"), verify_integrity=True)
+        _, step = AutoResume(str(tmp_path)).resume()
+        assert step == 1
+
     def test_legacy_manifest_without_integrity_section(self, tmp_path):
         """Pre-integrity checkpoints still verify (length/existence
         only) and still restore."""
@@ -304,6 +322,49 @@ class TestRetry:
             with pytest.raises(faults.InjectedIOError):
                 ckpt.save(str(tmp_path / "c"), _tree())
 
+    def test_rename_exhausted_preserves_previous_checkpoint(self, tmp_path):
+        """Overwrite-mode save parks the old checkpoint aside; if every
+        rename attempt fails, the old checkpoint is restored — retry
+        exhaustion must never leave a hole where a checkpoint was."""
+        path = str(tmp_path / "c")
+        ckpt.save(path, _tree(1.0))
+        with faults.failing_renames(forever=True):
+            with pytest.raises(faults.InjectedIOError):
+                ckpt.save(path, _tree(2.0))
+        assert ckpt.verify(path) == []
+        out = ckpt.restore(path)
+        np.testing.assert_array_equal(np.asarray(out["params"]["w"]), 1.0)
+        assert not os.path.exists(path + ".old")  # parked copy renamed back
+
+    def test_parked_old_checkpoint_from_crashed_attempt_is_recovered(
+            self, tmp_path):
+        """A prior attempt (or process) that died between parking the
+        old checkpoint at .old and landing the new rename must not have
+        its parked copy destroyed by the next attempt — it is the only
+        surviving copy and gets renamed back into place."""
+        path = str(tmp_path / "c")
+        ckpt.save(path, _tree(1.0))
+        os.rename(path, path + ".old")  # simulated crash window
+        with faults.failing_renames(forever=True):
+            with pytest.raises(faults.InjectedIOError):
+                ckpt.save(path, _tree(2.0))
+        assert ckpt.verify(path) == []
+        out = ckpt.restore(path)
+        np.testing.assert_array_equal(np.asarray(out["params"]["w"]), 1.0)
+
+    def test_read_paths_heal_crash_between_park_and_rename(self, tmp_path):
+        """SIGKILL between parking the old checkpoint at .old and the
+        tmp→final rename strands the only complete copy at .old; the
+        read paths (verify/restore) must recover it, not wait for the
+        next save to the same path."""
+        path = str(tmp_path / "c")
+        ckpt.save(path, _tree(5.0))
+        os.rename(path, path + ".old")  # the crash window, frozen
+        assert ckpt.verify(path) == []  # healed on read
+        out = ckpt.restore(path)
+        np.testing.assert_array_equal(np.asarray(out["params"]["w"]), 5.0)
+        assert not os.path.exists(path + ".old")
+
     def test_retry_only_matching_paths(self, tmp_path):
         """path_substr scopes injection: the other checkpoint's writes
         pass through untouched."""
@@ -409,6 +470,56 @@ class TestAutoResumeValidation:
         _, step = ar.resume()
         assert step == 3
 
+    def test_gc_keeps_valid_checkpoint_over_corrupt_newer(self, tmp_path):
+        """keep=1, step_8 valid, step_9/10 bit-flipped: resume falls
+        back to 8, the next save overwrites step_9 — GC must keep that
+        just-written valid checkpoint and remove the corrupt step_10,
+        NOT the reverse (corrupt dirs never count toward ``keep``)."""
+        _save_steps(tmp_path, (8, 9, 10))
+        for s in (9, 10):
+            faults.flip_bit(str(tmp_path / f"step_{s}" / "data.bin"), 5)
+        ar = AutoResume(str(tmp_path), interval_steps=1, keep=1)
+        _, step = ar.resume()
+        assert step == 8
+        assert ar.maybe_save(9, _tree(9.0))
+        assert sorted(os.listdir(str(tmp_path))) == ["step_9"]
+        assert ckpt.latest_valid_step(str(tmp_path)) == 9
+        _, step = AutoResume(str(tmp_path)).resume()
+        assert step == 9
+
+    def test_gc_removes_corrupt_dirs_in_keep_window(self, tmp_path):
+        """Corrupt dirs inside the newest-``keep`` window are deleted
+        and their keep slots go to older valid checkpoints."""
+        _save_steps(tmp_path, (8, 9, 10))
+        for s in (9, 10):
+            faults.truncate_file(str(tmp_path / f"step_{s}" / "data.bin"))
+        ar = AutoResume(str(tmp_path), interval_steps=1, keep=2)
+        _, step = ar.resume()
+        assert step == 8
+        assert ar.maybe_save(11, _tree(11.0))
+        assert sorted(os.listdir(str(tmp_path))) == ["step_11", "step_8"]
+
+    def test_gc_retains_checkpoint_on_transient_verify_error(
+            self, tmp_path, monkeypatch):
+        """A storage blip while GC verifies a dir must not condemn it:
+        the dir stays on disk (uncounted), only genuinely corrupt or
+        beyond-quota dirs are removed."""
+        _save_steps(tmp_path, (8, 9))
+        ar = AutoResume(str(tmp_path), interval_steps=1, keep=2)
+        real_verify = ckpt.verify
+
+        def flaky_verify(path, **kw):
+            if path.endswith("step_9"):
+                raise OSError("transient read error")
+            return real_verify(path, **kw)
+
+        monkeypatch.setattr(ckpt, "verify", flaky_verify)
+        assert ar.maybe_save(10, _tree(10.0))
+        names = sorted(os.listdir(str(tmp_path)))
+        # step_9 is inside the keep window but could not be verified:
+        # retained (uncounted), its keep slot going to valid step_8
+        assert names == ["step_10", "step_8", "step_9"]
+
 
 # ============================================================== StepGuard
 class TestStepGuard:
@@ -454,6 +565,81 @@ class TestStepGuard:
         assert g.observe(False).action == "rollback"
         with pytest.raises(DivergenceError):
             g.observe(False)
+
+    def test_rollback_discards_newer_step_dirs(self, tmp_path):
+        """Rollback must be durable: step dirs newer than the restored
+        step are removed, so a crash right after rollback resumes from
+        the rollback point instead of a stale newer checkpoint."""
+        _save_steps(tmp_path, (2, 4))
+        faults.flip_bit(str(tmp_path / "step_4" / "data.bin"), 3)
+        ar = AutoResume(str(tmp_path), keep=3)
+        g = StepGuard(autoresume=ar, warn_after=1, rollback_after=1,
+                      raise_after=3)
+        v = g.observe(False)
+        assert v.action == "rollback" and v.restored_step == 2
+        assert not os.path.exists(str(tmp_path / "step_4"))
+        _, step = AutoResume(str(tmp_path)).resume()
+        assert step == 2
+
+    def test_rollback_skips_checksum_valid_diverged_checkpoint(
+            self, tmp_path):
+        """A divergence that outlives a save interval leaves
+        checksum-valid NaN snapshots on disk; rollback must walk past
+        them (and remove them) instead of resuming into the diverged
+        state."""
+        ckpt.save_step(str(tmp_path), 2, _tree(2.0))
+        ckpt.save_step(str(tmp_path), 4, faults.poison_tree(_tree(4.0)))
+        assert ckpt.verify(str(tmp_path / "step_4")) == []  # checksums ok
+        ar = AutoResume(str(tmp_path), keep=3)
+        g = StepGuard(autoresume=ar, warn_after=1, rollback_after=1,
+                      raise_after=3)
+        v = g.observe(False)
+        assert v.action == "rollback"
+        assert v.restored_step == 2
+        assert np.isfinite(
+            np.asarray(v.restored_state["params"]["w"])).all()
+        assert not os.path.exists(str(tmp_path / "step_4"))
+        _, step = AutoResume(str(tmp_path)).resume()
+        assert step == 2
+
+    def test_rollback_quarantines_rather_than_deletes(self, tmp_path):
+        """When every checkpoint on disk is checksum-valid-but-NaN,
+        rollback must not erase the training history: each is renamed
+        to step_<N>.discarded (invisible to resume, preserved for
+        forensics) and the verdict carries state=None."""
+        for s in (2, 4):
+            ckpt.save_step(str(tmp_path), s,
+                           faults.poison_tree(_tree(float(s))))
+        ar = AutoResume(str(tmp_path), keep=3)
+        g = StepGuard(autoresume=ar, warn_after=1, rollback_after=1,
+                      raise_after=3)
+        v = g.observe(False)
+        assert v.action == "rollback" and v.restored_state is None
+        assert sorted(os.listdir(str(tmp_path))) == \
+            ["step_2.discarded", "step_4.discarded"]
+        assert AutoResume(str(tmp_path)).resume() == (None, 0)
+
+    def test_rollback_terminates_when_discard_has_no_effect(self, tmp_path):
+        """If discarding a poisoned checkpoint silently fails (e.g. no
+        delete permission), resume hands the same step back — the walk
+        must bail out with that state instead of looping forever."""
+        root = str(tmp_path)
+        ckpt.save_step(root, 2, faults.poison_tree(_tree(2.0)))
+
+        class StuckAutoResume:
+            def resume(self, target=None):
+                return ckpt.restore_latest_valid(root, target=target)
+
+            def discard_step(self, step):
+                pass  # broken: the dir never actually goes away
+
+            def discard_steps_after(self, step):
+                pass
+
+        g = StepGuard(autoresume=StuckAutoResume(), warn_after=1,
+                      rollback_after=1, raise_after=3)
+        v = g.observe(False)  # must terminate
+        assert v.action == "rollback" and v.restored_step == 2
 
     def test_rollback_skipped_without_autoresume(self):
         g = StepGuard(warn_after=1, rollback_after=2, raise_after=4)
