@@ -1,0 +1,36 @@
+"""Version-portability shims for jax symbols the framework uses inside
+``shard_map`` bodies.
+
+The pinned/newer jax exposes ``lax.axis_size`` and ``lax.pcast``; jax
+0.4.x (still common on CI hosts) has neither.  One shim module keeps
+every call site identical across versions instead of scattering
+``hasattr`` guards:
+
+- :func:`axis_size` — static axis extent.  Under 0.4.x shard_map,
+  ``psum(1, axis)`` of a python literal constant-folds to a static
+  python int, so it is usable in shape arithmetic on both versions.
+- :func:`pcast` — varying/invariant cast of the vma type system.
+  0.4.x has no vma typing, so the cast is a numeric identity there
+  (autodiff under its ``check_rep`` model already keeps per-device
+  grads local, which is what ``to="varying"`` exists to force).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["axis_size", "pcast"]
+
+
+def axis_size(axis_name):
+    """Static extent of a bound mesh axis (or tuple product)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast(x, axis_name, *, to):
+    """vma cast; identity where the vma system does not exist."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to=to)
+    return x

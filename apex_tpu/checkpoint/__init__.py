@@ -236,6 +236,10 @@ def verify(path: str, *, deep: bool = True,
     """Integrity-check a checkpoint directory; returns the list of
     file names that fail (empty == valid).
 
+    SECURITY: checksums detect corruption, not tampering — restoring
+    unpickles ``treedef.pkl``, so checkpoints are trusted input; only
+    verify/restore files your own training wrote.
+
     Checks, in order: the manifest parses; each checksummed file exists
     with the recorded byte length; its chunked CRC32s match (read
     streaming, ``chunk_bytes`` at a time, so multi-GB blobs verify in
@@ -384,6 +388,11 @@ def restore(path: str, target: Optional[Any] = None,
     """Load a pytree saved by :func:`save`.  With ``target`` given, the
     stored structure is validated against it and leaves are cast onto
     the target's dtypes/shapes.
+
+    SECURITY: the tree structure is UNPICKLED from ``treedef.pkl``
+    (arbitrary code execution for an attacker-controlled file) —
+    checkpoints are trusted input; restore only paths your own
+    training wrote.
 
     The blob's byte length is always validated against the
     manifest-computed size before ``csrc.unflatten`` touches it;

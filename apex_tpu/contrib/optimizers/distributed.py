@@ -51,6 +51,13 @@ __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
            "reestablish_replicated"]
 
 
+def _axis_size(axis_name) -> int:
+    """Static axis extent (shared version-portable shim)."""
+    from apex_tpu._compat import axis_size
+
+    return int(axis_size(axis_name))
+
+
 def reestablish_replicated(params: Any, param_specs: Any,
                            axes: Tuple[str, ...] = ("pp", "tp")) -> Any:
     """Re-mark model-axis-replicated params invariant after a ZeRO step.
@@ -139,13 +146,29 @@ class _DistributedOptimizer:
 
     def __init__(self, lr: float, axis_name: Any = DATA_PARALLEL_AXIS,
                  compressed_allgather: Optional[str] = None,
-                 param_specs: Any = None):
+                 param_specs: Any = None,
+                 compression: Any = None):
+        from apex_tpu.ops.quantization import as_compression_config
+
         if compressed_allgather not in (None, "bf16", "e5m2"):
             raise ValueError(
                 "compressed_allgather must be None, 'bf16' or 'e5m2'"
             )
         self.lr = lr
         self.axis_name = axis_name
+        # opt-in int8 quantization of the DCN leg of the hierarchical
+        # gradient reduce (the lax.psum of the 1/ici reduce-scattered
+        # shard across dcn) — the ici RS/AG legs, the fp32 masters and
+        # the param all-gather are untouched.  Error feedback (config
+        # default) rides the optimizer state as state["comm"]
+        self.compression = as_compression_config(compression)
+        if self.compression is not None and not isinstance(
+            axis_name, (tuple, list)
+        ):
+            raise ValueError(
+                "compression quantizes the DCN leg of the hierarchical "
+                "reduce: pass axis_name=(dcn_axis, ici_axis)"
+            )
         # opt-in lossy compression of the parameter all-gather payload
         # (reference: distributed_fused_adam.py e5m2 compressed allgather):
         # masters stay fp32; only the gathered bytes shrink 2x/4x
@@ -259,6 +282,14 @@ class _DistributedOptimizer:
         specs = {k: P(ax) for k in self._extra_init(1)}
         specs["step"] = P()
         specs["master"] = P(ax)
+        if (self.compression is not None
+                and self.compression.error_feedback):
+            # quantization residuals vary over BOTH data axes: each
+            # (dcn, ici) position compensates its own rounding error
+            cax = ((*model_axes, self._cross_axis, self._shard_axis)
+                   if model_axes
+                   else (self._cross_axis, self._shard_axis))
+            specs["comm"] = {"push": P(cax), "pull": P(cax)}
         if self._mask is not None:
             # data-axis-sharded leaves keep the PARAM's own spec: their
             # state lives exactly where the shard lives.  NOTE the spec
@@ -285,13 +316,21 @@ class _DistributedOptimizer:
         if self._mask is not None:
             local_tree = self._mask_tree(params, self._mask, True)
             params = self._mask_tree(params, self._mask, False)
-        world = lax.axis_size(self._shard_axis)
+        world = _axis_size(self._shard_axis)
         rank = lax.axis_index(self._shard_axis)
         meta = _FlatMeta(params, world)
         flat = meta.flatten(params)
         local = lax.dynamic_slice(flat, (rank * meta.shard,), (meta.shard,))
         state = {"step": jnp.int32(0), "master": local}
         state.update(self._extra_init(meta.shard))
+        if (self.compression is not None
+                and self.compression.error_feedback):
+            from apex_tpu.ops.quantization import init_residual
+
+            state["comm"] = init_residual(
+                meta.shard, _axis_size(self._cross_axis),
+                self.compression.block_size,
+            )
         if local_tree is not None:
             f32_tree = jax.tree.map(
                 lambda x: jnp.asarray(x, jnp.float32), local_tree)
@@ -333,7 +372,7 @@ class _DistributedOptimizer:
             local_grads = self._mask_tree(grads, self._mask, True)
             params = self._mask_tree(params, self._mask, False)
             grads = self._mask_tree(grads, self._mask, False)
-        world = lax.axis_size(self._shard_axis)
+        world = _axis_size(self._shard_axis)
         rank = lax.axis_index(self._shard_axis)
         meta = _FlatMeta(params, world)
         lr = f32(self.lr if lr is None else lr)
@@ -341,14 +380,25 @@ class _DistributedOptimizer:
         flat_grads = meta.flatten(grads)
         # mean-reduce-scatter: each rank receives its shard of the
         # dp-summed gradient.  Hierarchical: RS within ici, then AR of
-        # the 1/ici shard across dcn (reference's 2-level pattern)
+        # the 1/ici shard across dcn (reference's 2-level pattern) —
+        # optionally int8-quantized, the only lossy leg when
+        # ``compression`` is set
         g_local = lax.psum_scatter(
             flat_grads, self._shard_axis, tiled=True
         )
         total = world
+        new_comm = None
         if self._cross_axis is not None:
-            g_local = lax.psum(g_local, self._cross_axis)
-            total = world * lax.axis_size(self._cross_axis)
+            if self.compression is not None:
+                from apex_tpu.ops.quantization import quantized_psum
+
+                g_local, new_comm = quantized_psum(
+                    g_local, self._cross_axis, self.compression,
+                    residual=state.get("comm"), step=state["step"],
+                )
+            else:
+                g_local = lax.psum(g_local, self._cross_axis)
+            total = world * _axis_size(self._cross_axis)
         g_local = g_local / total
         ids = meta.segment_ids()
         ids_local = lax.dynamic_slice(
@@ -357,7 +407,8 @@ class _DistributedOptimizer:
 
         new_step = state["step"] + 1
         extra = {
-            k: v for k, v in state.items() if k not in ("step", "master")
+            k: v for k, v in state.items()
+            if k not in ("step", "master", "comm")
         }
         new_master, new_extra = self._update_shard(
             extra, new_step, g_local, state["master"], lr, meta, ids_local
@@ -366,6 +417,11 @@ class _DistributedOptimizer:
         new_state = dict(new_extra)
         new_state["step"] = new_step
         new_state["master"] = new_master
+        if new_comm is not None:
+            # grads_finite=False reverts this with the rest of the
+            # state below (tree_where): a skipped step must not absorb
+            # the overflow garbage into the error-feedback residual
+            new_state["comm"] = new_comm
         if local_params is not None:
             # rank-local update of the data-axis-sharded leaves: no
             # collectives — their grads are already complete on the
@@ -374,7 +430,7 @@ class _DistributedOptimizer:
             lextra = {k: v for k, v in state["local"].items()
                       if k != "master"}
             lscale = (1.0 if local_grads_prenormalized
-                      else 1.0 / lax.axis_size(self._shard_axis))
+                      else 1.0 / _axis_size(self._shard_axis))
             lgrads = jax.tree.map(
                 lambda g: jnp.asarray(g, jnp.float32) * lscale,
                 local_grads)
@@ -423,10 +479,12 @@ class DistributedFusedAdam(_DistributedOptimizer):
         axis_name: Any = DATA_PARALLEL_AXIS,
         compressed_allgather: Optional[str] = None,
         param_specs: Any = None,
+        compression: Any = None,
     ):
         super().__init__(lr=lr, axis_name=axis_name,
                          compressed_allgather=compressed_allgather,
-                         param_specs=param_specs)
+                         param_specs=param_specs,
+                         compression=compression)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -494,10 +552,12 @@ class DistributedFusedLAMB(_DistributedOptimizer):
         axis_name: Any = DATA_PARALLEL_AXIS,
         compressed_allgather: Optional[str] = None,
         param_specs: Any = None,
+        compression: Any = None,
     ):
         super().__init__(lr=lr, axis_name=axis_name,
                          compressed_allgather=compressed_allgather,
-                         param_specs=param_specs)
+                         param_specs=param_specs,
+                         compression=compression)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps

@@ -29,6 +29,7 @@ from apex_tpu.transformer.tensor_parallel import (
     VocabParallelEmbedding,
     vocab_parallel_cross_entropy,
 )
+from apex_tpu._compat import axis_size as _axis_size
 
 __all__ = ["BertConfig", "BertModel"]
 
@@ -221,7 +222,7 @@ class BertModel:
     # ------------------------------------------------------------- forward
     def _layer(self, lp, x, segs):
         c = self.config
-        world = jax.lax.axis_size(self.axis_name)
+        world = _axis_size(self.axis_name)
         heads_local = c.num_attention_heads // world
         b, s, h = x.shape
 
@@ -617,7 +618,7 @@ class BertModel:
             return M * loss_m
 
         fwd_bwd = get_forward_backward_func(
-            pipeline_model_parallel_size=jax.lax.axis_size(
+            pipeline_model_parallel_size=_axis_size(
                 PIPELINE_PARALLEL_AXIS
             ),
         )

@@ -54,6 +54,7 @@ from apex_tpu.transformer.tensor_parallel.random import (
     data_parallel_key,
     model_parallel_key,
 )
+from apex_tpu._compat import axis_size as _axis_size
 
 __all__ = ["GPTConfig", "GPTModel"]
 
@@ -372,7 +373,7 @@ class GPTModel:
         (cos, sin) tables from :meth:`_rope_tables` (None for learned
         positions)."""
         c = self.config
-        world = jax.lax.axis_size(self.axis_name)
+        world = _axis_size(self.axis_name)
         heads_local = c.num_attention_heads // world
         b, s, h = x.shape
 
@@ -814,7 +815,7 @@ class GPTModel:
 
         fwd_bwd = get_forward_backward_func(
             virtual_pipeline_model_parallel_size=num_model_chunks,
-            pipeline_model_parallel_size=jax.lax.axis_size(
+            pipeline_model_parallel_size=_axis_size(
                 PIPELINE_PARALLEL_AXIS
             ),
         )
@@ -839,7 +840,7 @@ class GPTModel:
             # (MoE experts ride "dp" as the ep axis): the all_to_all
             # transpose already accumulated every shard's contribution
             # into the owner, so the mean is just the 1/n scale.
-            n = jax.lax.axis_size(axis)
+            n = _axis_size(axis)
             if axis in spec_axis_names(s):
                 return g / n
             return jax.lax.pmean(g, axis)

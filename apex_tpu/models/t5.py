@@ -54,6 +54,7 @@ from apex_tpu.transformer.tensor_parallel import (
     VocabParallelEmbedding,
     vocab_parallel_cross_entropy,
 )
+from apex_tpu._compat import axis_size as _axis_size
 
 __all__ = ["T5Config", "T5Model"]
 
@@ -251,7 +252,7 @@ class T5Model:
         """(b, s, n*heads_local*d) → n arrays of (b, heads_local, s, d),
         head-grouped layout as in GPT (tp-invariant slices)."""
         c = self.config
-        world = jax.lax.axis_size(self.axis_name)
+        world = _axis_size(self.axis_name)
         heads_local = c.num_attention_heads // world
         b, s, _ = x.shape
         x = x.reshape(b, s, heads_local, n, c.head_dim)
@@ -738,7 +739,7 @@ class T5Model:
         )
 
         c = self.config
-        pp = jax.lax.axis_size(PIPELINE_PARALLEL_AXIS)
+        pp = _axis_size(PIPELINE_PARALLEL_AXIS)
         if parallel_state.get_pipeline_model_parallel_split_rank() is not None:
             fwd_bwd = get_forward_backward_func(
                 pipeline_model_parallel_size=pp,

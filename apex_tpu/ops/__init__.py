@@ -26,8 +26,18 @@ from apex_tpu.ops.attention import (  # noqa: F401
 from apex_tpu.ops.attention_short import (  # noqa: F401
     fmha_short,
 )
+from apex_tpu.ops.quantization import (  # noqa: F401
+    CompressionConfig,
+    dequantize_blockwise,
+    quantize_blockwise,
+    quantized_psum,
+)
 
 __all__ = [
+    "CompressionConfig",
+    "dequantize_blockwise",
+    "quantize_blockwise",
+    "quantized_psum",
     "fmha_short",
     "fused_layer_norm",
     "fused_layer_norm_affine",

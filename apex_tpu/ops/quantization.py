@@ -1,0 +1,308 @@
+"""Block-wise int8 quantization for compressed gradient collectives.
+
+The hierarchical RS(ici) → AR(dcn) → AG(ici) decomposition
+(:func:`apex_tpu.parallel.distributed._hierarchical_psum`) already cuts
+DCN traffic to 1/ici of the gradient — but every byte that does cross
+the slow axis is still full-width.  EQuARX (PAPERS.md) shows a
+block-quantized all-reduce recovers most of that bandwidth on XLA/TPU
+with negligible quality loss, and the adaptive-summation line of work
+(Maleki et al.) is why any lossy reduction here carries an explicit
+error-feedback residual: the quantization error of step *t* is added
+back into the gradient of step *t+1*, so the bias is compensated
+instead of accumulated.
+
+This module is the numeric core plus the one compressed collective:
+
+- :func:`quantize_blockwise` / :func:`dequantize_blockwise` — flat
+  int8 values with one fp32 scale per ``block_size`` elements,
+  deterministic (round-half-even) or stochastic rounding, bf16/fp32
+  in/out;
+- :class:`CompressionConfig` — the ``compression=`` knob's value
+  (the string ``"int8"`` is accepted everywhere as the default config);
+- :func:`quantized_psum` — an int8 all-reduce over ONE mesh axis,
+  built for the DCN leg: quantize once, exchange int8 + scales with
+  ``all_to_all`` (the reduce-scatter phase), accumulate the exact
+  int8 x fp32-scale products, re-quantize the reduced shard once, and
+  ``all_gather`` int8 + scales back.  Only the tiny fp32 scale
+  sidecar (``4 / block_size`` bytes per element) crosses the axis at
+  full width, so bytes-on-wire drop ~4x vs an fp32 psum.
+
+Deviation from the ISSUE's "(int32-accumulated values, scales)"
+sketch: each sender keeps its OWN per-block scales (no extra
+max-scale collective on the slow axis, and a small-magnitude sender
+is not coarsened by a large-magnitude peer's amax); the receiver then
+accumulates ``int8 * fp32_scale`` products, which is at least as
+accurate as sharing scales and summing in int32, for any axis size
+that fits training practice.
+
+Everything here is pure ``jnp``/``lax`` — the collective must be
+called inside ``shard_map`` (or ``pmap``) with the axis bound.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "CompressionConfig",
+    "as_compression_config",
+    "quantize_blockwise",
+    "dequantize_blockwise",
+    "comm_residual_sizes",
+    "init_residual",
+    "quantized_psum",
+]
+
+_INT8_MAX = 127.0
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionConfig:
+    """Configuration for compressed (quantized) collectives.
+
+    ``method``: only ``"int8"`` today.  ``block_size``: elements per
+    fp32 scale (wire overhead = 4/block_size bytes per element).
+    ``rounding``: ``"nearest"`` (deterministic, round-half-even) or
+    ``"stochastic"`` (unbiased; pass a fresh ``key`` per step, or
+    thread comm state so the built-in step counter derives one).
+    ``error_feedback``: carry the per-device quantization residual as
+    explicit state and add it back next step (strongly recommended for
+    training; requires the caller to thread a state pytree).
+    """
+
+    method: str = "int8"
+    block_size: int = 256
+    rounding: str = "nearest"
+    error_feedback: bool = True
+
+    def __post_init__(self):
+        if self.method != "int8":
+            raise ValueError(
+                f"unsupported compression method {self.method!r} "
+                "(only 'int8')"
+            )
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        if self.rounding not in ("nearest", "stochastic"):
+            raise ValueError(
+                f"rounding must be 'nearest' or 'stochastic', got "
+                f"{self.rounding!r}"
+            )
+
+
+def as_compression_config(
+    compression: Union[None, str, CompressionConfig]
+) -> Optional[CompressionConfig]:
+    """Normalize the ``compression=`` knob: None | "int8" | config."""
+    if compression is None:
+        return None
+    if isinstance(compression, CompressionConfig):
+        return compression
+    if isinstance(compression, str):
+        return CompressionConfig(method=compression)
+    raise ValueError(
+        f"compression must be None, 'int8' or a CompressionConfig, got "
+        f"{compression!r}"
+    )
+
+
+def _axis_size(axis_name) -> int:
+    from apex_tpu._compat import axis_size
+
+    return int(axis_size(axis_name))
+
+
+def _blocks(flat: jnp.ndarray, block_size: int) -> jnp.ndarray:
+    n = flat.size
+    pad = (-n) % block_size
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad,), flat.dtype)]
+        )
+    return flat.reshape(-1, block_size)
+
+
+def quantize_blockwise(
+    x: jnp.ndarray,
+    block_size: int = 256,
+    rounding: str = "nearest",
+    key: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize to int8 with one fp32 scale per block.
+
+    ``x`` (any shape, bf16/fp32) is flattened; blocks of
+    ``block_size`` elements share ``scale = max|block| / 127``
+    (all-zero blocks get scale 1 so dequantization is exact).
+    Returns ``(values, scales)``: ``values`` int8 with ``x``'s shape,
+    ``scales`` fp32 of shape ``(ceil(x.size / block_size),)``.
+
+    ``rounding="nearest"`` is deterministic (ties to even);
+    ``"stochastic"`` computes ``floor(v + u)``, ``u ~ U[0, 1)`` from
+    ``key`` (required), which is unbiased: ``E[q] = v``.
+    """
+    shape = x.shape
+    xf = _blocks(x.reshape(-1).astype(jnp.float32), block_size)
+    amax = jnp.max(jnp.abs(xf), axis=1)
+    scales = jnp.where(amax > 0.0, amax / _INT8_MAX, 1.0)
+    v = jnp.clip(xf / scales[:, None], -_INT8_MAX, _INT8_MAX)
+    if rounding == "stochastic":
+        if key is None:
+            raise ValueError("stochastic rounding requires a PRNG key")
+        u = jax.random.uniform(key, v.shape, jnp.float32)
+        q = jnp.floor(v + u)
+    else:
+        q = jnp.round(v)
+    q = jnp.clip(q, -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q.reshape(-1)[: int(jnp.size(x))].reshape(shape), scales
+
+
+def dequantize_blockwise(
+    values: jnp.ndarray,
+    scales: jnp.ndarray,
+    block_size: int = 256,
+    dtype: Any = jnp.float32,
+) -> jnp.ndarray:
+    """Inverse of :func:`quantize_blockwise` (up to rounding error)."""
+    shape = values.shape
+    q = _blocks(values.reshape(-1).astype(jnp.float32), block_size)
+    out = q * scales[:, None]
+    return out.reshape(-1)[: int(jnp.size(values))].reshape(shape).astype(
+        dtype
+    )
+
+
+def comm_residual_sizes(
+    n: int, world: int, block_size: int
+) -> Tuple[int, int]:
+    """Per-device error-feedback buffer lengths for a
+    :func:`quantized_psum` over an ``n``-element array on a
+    ``world``-wide axis: ``(padded_total, shard)`` — the ``push``
+    residual covers the locally quantized (padded) array, the ``pull``
+    residual the re-quantized reduced shard this rank owns."""
+    padded = n + (-n) % (world * block_size)
+    return padded, padded // world
+
+
+def init_residual(
+    n: int, world: int, block_size: int = 256
+) -> dict:
+    """Zero error-feedback state for ONE flat array of ``n`` elements
+    reduced over a ``world``-wide axis.  ``push`` compensates the
+    first quantization (this rank's contribution), ``pull`` the
+    second (the reduced shard this rank re-broadcasts)."""
+    padded, shard = comm_residual_sizes(n, world, block_size)
+    return {
+        "push": jnp.zeros((padded,), jnp.float32),
+        "pull": jnp.zeros((shard,), jnp.float32),
+    }
+
+
+def _rounding_key(
+    cfg: CompressionConfig,
+    axis_name,
+    key: Optional[jnp.ndarray],
+    step: Optional[jnp.ndarray],
+) -> Optional[jnp.ndarray]:
+    if cfg.rounding != "stochastic":
+        return None
+    if key is None:
+        if step is None:
+            # a constant key would re-roll the SAME dither every step,
+            # turning "unbiased in expectation" into a fixed systematic
+            # bias — refuse rather than silently degrade
+            raise ValueError(
+                "stochastic rounding needs per-step randomness: pass "
+                "key= or thread comm state (its step counter derives "
+                "one)"
+            )
+        key = jax.random.fold_in(jax.random.PRNGKey(0), step)
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+
+
+def quantized_psum(
+    x: jnp.ndarray,
+    axis_name,
+    compression: Union[str, CompressionConfig] = "int8",
+    residual: Optional[dict] = None,
+    key: Optional[jnp.ndarray] = None,
+    step: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, Optional[dict]]:
+    """Approximate ``lax.psum(x, axis_name)`` with int8 bytes on wire.
+
+    Three collectives replace the one full-width all-reduce, all over
+    ``axis_name`` only (call this on the SLOW axis):
+
+    1. each rank block-quantizes its (padded) array and ``all_to_all``s
+       int8 values + fp32 scales — the reduce-scatter phase, 1 byte +
+       4/block per element;
+    2. each rank accumulates its shard from the received
+       ``int8 x fp32-scale`` products (exact in fp32) — no bytes;
+    3. the reduced shard is re-quantized and ``all_gather``-ed back,
+       again 1 byte + 4/block per element.
+
+    With ``residual`` (from :func:`init_residual`), both quantization
+    events run with error feedback: the residual is added before
+    quantizing and the new rounding error is returned as fresh state —
+    pass it back next step.  Without it the call is stateless (and
+    lossier over many steps).
+
+    Non-finite inputs quantize to garbage (an inf amax zeroes the
+    block): run overflow detection on the *inputs* (the loss-scaler
+    consensus) and discard the returned residual for skipped steps.
+
+    Returns ``(psum_approx, new_residual)`` — ``new_residual`` is None
+    when ``residual`` is None; the output has ``x``'s shape and dtype.
+    """
+    cfg = as_compression_config(compression)
+    world = _axis_size(axis_name)
+    block = cfg.block_size
+    shape, dtype, n = x.shape, x.dtype, int(jnp.size(x))
+    padded, shard = comm_residual_sizes(n, world, block)
+
+    flat = x.reshape(-1).astype(jnp.float32)
+    if padded != n:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((padded - n,), jnp.float32)]
+        )
+    rkey = _rounding_key(cfg, axis_name, key, step)
+    k1 = k2 = None
+    if rkey is not None:
+        k1, k2 = jax.random.split(rkey)
+
+    if residual is not None:
+        flat = flat + residual["push"]
+    q, s = quantize_blockwise(flat, block, cfg.rounding, k1)
+    new_residual = None
+    if residual is not None:
+        new_push = flat - dequantize_blockwise(q, s, block)
+
+    # reduce-scatter phase: row r of the (world, shard) layout belongs
+    # to rank r; exchange rows (and their scales) as int8/fp32
+    qt = jax.lax.all_to_all(q.reshape(world, shard), axis_name, 0, 0)
+    st = jax.lax.all_to_all(
+        s.reshape(world, shard // block), axis_name, 0, 0
+    )
+    contrib = qt.astype(jnp.float32) * jnp.repeat(st, block, axis=1)
+    y = jnp.sum(contrib, axis=0)
+
+    if residual is not None:
+        y = y + residual["pull"]
+    q2, s2 = quantize_blockwise(y, block, cfg.rounding, k2)
+    if residual is not None:
+        new_pull = y - dequantize_blockwise(q2, s2, block)
+        new_residual = {"push": new_push, "pull": new_pull}
+
+    # invariant-typed gather (every rank receives identical bytes, so
+    # the reconstruction is replicated over the axis)
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        all_gather_invariant,
+    )
+
+    gq = all_gather_invariant(q2, axis_name, axis=0, tiled=True)
+    gs = all_gather_invariant(s2, axis_name, axis=0, tiled=True)
+    out = dequantize_blockwise(gq, gs, block)[:n]
+    return out.reshape(shape).astype(dtype), new_residual

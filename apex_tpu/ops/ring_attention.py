@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.transformer.parallel_state import CONTEXT_PARALLEL_AXIS
+from apex_tpu._compat import axis_size as _axis_size
 
 __all__ = ["ring_attention", "ring_attention_reference"]
 
@@ -61,7 +62,7 @@ def ring_attention(
     """
     b, h, s_local, d = q.shape
     scale = (1.0 / d**0.5) if sm_scale is None else float(sm_scale)
-    cp = lax.axis_size(axis_name)
+    cp = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     bk = min(block_k, s_local)

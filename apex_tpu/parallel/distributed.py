@@ -16,21 +16,36 @@ What survives as *semantics* are the knobs, reproduced here exactly:
 ``gradient_average`` (divide by world size), ``gradient_predivide_factor``
 (divide by f before the reduce and by world/f after,
 reference: distributed.py:463-476), and ``allreduce_always_fp32``.
+
+Compressed collectives: with a hierarchical ``(dcn_axis, ici_axis)``
+axis pair, ``compression="int8"`` block-quantizes ONLY the DCN leg of
+the reduce (:mod:`apex_tpu.ops.quantization`): the ici-reduced chunk is
+quantized once, exchanged over dcn as int8 values + per-block fp32
+scales, dequantized once — the ICI reduce-scatter/all-gather legs and
+the returned gradient dtype are untouched, and ``compression=None`` is
+bit-identical to the uncompressed path.  Error feedback (on by
+default) carries the per-device quantization residual as explicit
+state: build it with :func:`init_comm_state`, thread it through
+``all_reduce_gradients(..., comm_state=...)`` (or the
+``DistributedDataParallel``/``Reducer`` equivalents), and checkpoint it
+with the rest of the training state.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = [
     "data_parallel_mesh",
     "hierarchical_data_parallel_mesh",
     "all_reduce_gradients",
+    "init_comm_state",
+    "comm_state_specs",
     "DistributedDataParallel",
     "Reducer",
 ]
@@ -75,11 +90,24 @@ def hierarchical_data_parallel_mesh(
     return Mesh(grid, (dcn_axis, ici_axis))
 
 
-def _hierarchical_psum(g: jnp.ndarray, dcn_axis: str, ici_axis: str):
+def _hierarchical_psum(g: jnp.ndarray, dcn_axis: str, ici_axis: str,
+                       compression=None, residual=None, step=None,
+                       key=None):
     """All-reduce over both data axes as RS(ici) → AR(dcn) → AG(ici):
     mathematically ``psum`` over (dcn, ici), but each DCN message is only
     1/ici of the tensor (the reference's 2-level reduce,
-    distributed_fused_adam.py:106-160)."""
+    distributed_fused_adam.py:106-160).
+
+    With ``compression`` given, the AR(dcn) middle leg runs as an int8
+    block-quantized all-reduce (:func:`apex_tpu.ops.quantization.
+    quantized_psum`) — the ICI legs and the output dtype are untouched,
+    and ``compression=None`` takes the exact uncompressed path.
+    Returns ``(out, new_residual)``; ``new_residual`` is None unless an
+    error-feedback ``residual`` dict was passed."""
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        all_gather_invariant,
+    )
+
     n = g.size
     ici = _axis_size(ici_axis)
     flat = g.reshape(-1)
@@ -87,11 +115,23 @@ def _hierarchical_psum(g: jnp.ndarray, dcn_axis: str, ici_axis: str):
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     chunk = jax.lax.psum_scatter(flat, ici_axis, tiled=True)
-    chunk = jax.lax.psum(chunk, dcn_axis)
-    out = jax.lax.all_gather(chunk, ici_axis, axis=0, tiled=True)
+    new_residual = None
+    if compression is None:
+        chunk = jax.lax.psum(chunk, dcn_axis)
+    else:
+        from apex_tpu.ops.quantization import quantized_psum
+
+        chunk, new_residual = quantized_psum(
+            chunk, dcn_axis, compression, residual=residual, step=step,
+            key=key,
+        )
+    # invariant-typed gather: every ici rank receives the identical
+    # dcn-reduced chunk, so the result is replicated over both data
+    # axes and downstream P() out_specs typecheck (same HLO either way)
+    out = all_gather_invariant(chunk, ici_axis, axis=0, tiled=True)
     if pad:
         out = out[:n]
-    return out.reshape(g.shape)
+    return out.reshape(g.shape), new_residual
 
 
 def all_reduce_gradients(
@@ -100,6 +140,8 @@ def all_reduce_gradients(
     gradient_average: bool = True,
     gradient_predivide_factor: float = 1.0,
     allreduce_always_fp32: bool = False,
+    compression: Any = None,
+    comm_state: Optional[dict] = None,
 ) -> Any:
     """psum the grad pytree over ``axis_name`` (call inside shard_map/pmap).
 
@@ -109,38 +151,227 @@ def all_reduce_gradients(
     gradient bytes cross the slow interconnect (the reference's 2-level
     hierarchy, apex/contrib/optimizers/distributed_fused_adam.py:106-160).
 
+    ``compression`` (None | "int8" |
+    :class:`~apex_tpu.ops.quantization.CompressionConfig`) additionally
+    quantizes the DCN leg of the hierarchical pair to int8 + per-block
+    fp32 scales; it requires a hierarchical ``axis_name``, leaves the
+    ICI legs and gradient dtypes untouched, and ``None`` is
+    bit-identical to the uncompressed reduce.  With error feedback (the
+    config default) pass ``comm_state`` (from :func:`init_comm_state`);
+    the call then returns ``(grads, new_comm_state)`` instead of just
+    ``grads`` — thread the new state into the next step and checkpoint
+    it with the training state.
+
     Matches the reference's scaling semantics
     (reference: apex/parallel/distributed.py:463-476): grads are divided
     by ``predivide_factor`` before the reduction and by
     ``world_size / predivide_factor`` after, which in exact arithmetic is
     a mean over the axis but controls intermediate magnitude in fp16.
     """
+    from apex_tpu.ops.quantization import as_compression_config
+
+    cfg = as_compression_config(compression)
     hierarchical = isinstance(axis_name, (tuple, list))
+    if cfg is not None and not hierarchical:
+        raise ValueError(
+            "compression quantizes the DCN leg of a hierarchical "
+            "reduce: pass axis_name=(dcn_axis, ici_axis)"
+        )
+    if cfg is not None and comm_state is None and (
+        cfg.error_feedback or cfg.rounding == "stochastic"
+    ):
+        raise ValueError(
+            "this compression config needs explicit comm state (error "
+            "feedback carries residuals; stochastic rounding derives "
+            "its per-step key from the state's counter): build it with "
+            "init_comm_state(...) and pass comm_state="
+        )
+    if comm_state is not None and cfg is None:
+        raise ValueError("comm_state given without compression")
     if hierarchical:
         dcn_axis, ici_axis = axis_name
         world = _axis_size(dcn_axis) * _axis_size(ici_axis)
     else:
         world = _axis_size(axis_name)
 
-    def sync(g):
+    step = None if comm_state is None else comm_state["step"]
+
+    def sync(g, residual, key):
         orig_dtype = g.dtype
         if allreduce_always_fp32:
             g = g.astype(jnp.float32)
         if gradient_predivide_factor != 1.0:
             g = g / gradient_predivide_factor
         if hierarchical:
-            g = _hierarchical_psum(g, dcn_axis, ici_axis)
+            g, new_residual = _hierarchical_psum(
+                g, dcn_axis, ici_axis, compression=cfg,
+                residual=residual, step=step, key=key,
+            )
         else:
             g = jax.lax.psum(g, axis_name)
+            new_residual = None
         if gradient_average:
             post = world / gradient_predivide_factor
             if post != 1.0:
                 g = g / post
         elif gradient_predivide_factor != 1.0:
             g = g * gradient_predivide_factor
-        return g.astype(orig_dtype)
+        return g.astype(orig_dtype), new_residual
 
-    return jax.tree.map(sync, grads)
+    def leaf_key(i):
+        """Distinct dither per leaf AND per step — one shared key would
+        correlate the noise across same-shaped leaves."""
+        if cfg is None or cfg.rounding != "stochastic" or step is None:
+            return None
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(0), step), i
+        )
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if comm_state is None:
+        out = [sync(g, None, None)[0] for g in leaves]
+        return jax.tree_util.tree_unflatten(treedef, out)
+    residuals = treedef.flatten_up_to(comm_state["residuals"])
+    use_ef = cfg.error_feedback
+    synced = [
+        sync(g, r if use_ef else None, leaf_key(i))
+        for i, (g, r) in enumerate(zip(leaves, residuals))
+    ]
+    new_state = {
+        # error_feedback=False: the state only feeds the step counter,
+        # residuals pass through untouched
+        "residuals": jax.tree_util.tree_unflatten(
+            treedef, [r for _, r in synced]
+        ) if use_ef else comm_state["residuals"],
+        "step": comm_state["step"] + 1,
+    }
+    return jax.tree_util.tree_unflatten(
+        treedef, [g for g, _ in synced]
+    ), new_state
+
+
+def init_comm_state(
+    tree: Any,
+    axis_name: Tuple[str, str],
+    compression: Any = "int8",
+    mesh: Optional[Mesh] = None,
+    param_specs: Any = None,
+) -> dict:
+    """Zero error-feedback state for compressed hierarchical reduces of
+    a grad pytree shaped like ``tree``.
+
+    Residuals are sized from the PER-DEVICE gradient shapes the reduce
+    will see inside shard_map.  For the usual DDP setup (replicated
+    params, per-device grads of the same shape) that is simply the
+    params pytree; params sharded over MODEL axes (pp/tp stacks) have
+    smaller per-device leaves — pass their ``param_specs`` so the
+    host-side path can divide each dimension by the mesh axes that
+    shard it.
+
+    With ``mesh`` given this runs host-side and returns GLOBAL arrays
+    (place them with :func:`comm_state_specs`); without it, it must run
+    inside ``shard_map`` (axis sizes come from the bound axes, leaf
+    shapes are already local) and returns the per-device residuals
+    directly.  The state is ordinary checkpointable data: save/restore
+    it with the training state so a resumed run keeps its compensation
+    instead of restarting the quantization bias from zero."""
+    from apex_tpu.ops.quantization import (
+        as_compression_config,
+        comm_residual_sizes,
+    )
+
+    cfg = as_compression_config(compression)
+    if cfg is None:
+        raise ValueError("init_comm_state needs a compression config")
+    dcn_axis, ici_axis = axis_name
+    if mesh is not None:
+        dcn, ici = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+        replicas = dcn * ici
+    else:
+        dcn, ici = _axis_size(dcn_axis), _axis_size(ici_axis)
+        replicas = 1
+
+    def local_size(leaf, spec) -> int:
+        shape = list(jnp.shape(leaf)) or [1]
+        if mesh is not None and spec is not None:
+            for i, entry in enumerate(spec):
+                if entry is None or i >= len(shape):
+                    continue
+                names = entry if isinstance(entry, tuple) else (entry,)
+                for ax in names:
+                    shape[i] //= mesh.shape[ax]
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return n
+
+    def one(leaf, spec):
+        n = local_size(leaf, spec)
+        chunk = (n + (-n) % ici) // ici
+        padded, shard = comm_residual_sizes(chunk, dcn, cfg.block_size)
+        # a leaf sharded over MODEL axes (pp/tp stacks) carries a
+        # DISTINCT residual per model-axis position as well — the
+        # global buffer must hold every one of them
+        reps = replicas * _model_axis_extent(spec, mesh)
+        return {
+            "push": jnp.zeros((reps * padded,), jnp.float32),
+            "pull": jnp.zeros((reps * shard,), jnp.float32),
+        }
+
+    if param_specs is None:
+        residuals = jax.tree.map(lambda l: one(l, None), tree)
+    else:
+        residuals = jax.tree.map(one, tree, param_specs)
+    return {
+        "residuals": residuals,
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def _model_axis_extent(spec, mesh: Optional[Mesh]) -> int:
+    """Product of the mesh-axis sizes a leaf's spec shards it over."""
+    if spec is None or mesh is None:
+        return 1
+    from apex_tpu.transformer.parallel_state import spec_axis_names
+
+    extent = 1
+    for ax in spec_axis_names(spec):
+        extent *= mesh.shape[ax]
+    return extent
+
+
+def comm_state_specs(comm_state: dict,
+                     axis_name: Tuple[str, str],
+                     param_specs: Any = None) -> dict:
+    """shard_map / device_put specs for :func:`init_comm_state` output:
+    residuals are device-varying over both data axes (sharded along
+    axis 0), the step counter is replicated.
+
+    Pass the same ``param_specs`` given to :func:`init_comm_state` when
+    params are sharded over model axes: a pp/tp-sharded leaf's residual
+    varies over those axes too, and declaring it replicated there would
+    be rejected (or silently wrong) under shard_map."""
+    dcn_axis, ici_axis = axis_name
+    if param_specs is None:
+        specs = jax.tree.map(
+            lambda _: P((dcn_axis, ici_axis)), comm_state
+        )
+        specs["step"] = P()
+        return specs
+
+    from apex_tpu.transformer.parallel_state import spec_axis_names
+
+    def leaf_spec(spec):
+        axes = (dcn_axis, ici_axis, *spec_axis_names(spec))
+        return {"push": P(axes), "pull": P(axes)}
+
+    return {
+        "residuals": jax.tree.map(
+            leaf_spec, param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+        "step": P(),
+    }
 
 
 class DistributedDataParallel:
@@ -160,6 +391,12 @@ class DistributedDataParallel:
     (reference: apex/parallel/distributed.py:139-206); the
     stream/bucket/message-size knobs have no TPU meaning and are
     accepted-and-ignored for source compatibility.
+
+    ``compression`` (with a hierarchical ``axis_name=(dcn, ici)``
+    pair) quantizes the DCN leg of the reduce to int8; with error
+    feedback (the default) build residual state once with
+    :meth:`init_comm_state` and call ``ddp(grads, comm_state)``, which
+    then returns ``(grads, new_comm_state)``.
     """
 
     def __init__(
@@ -168,25 +405,57 @@ class DistributedDataParallel:
         gradient_average: bool = True,
         gradient_predivide_factor: float = 1.0,
         allreduce_always_fp32: bool = False,
+        compression: Any = None,
         # accepted for source compat; meaningless under XLA:
         message_size: int = 10000000,
         delay_allreduce: bool = False,
         num_allreduce_streams: int = 1,
         retain_allreduce_buffers: bool = False,
     ):
+        from apex_tpu.ops.quantization import as_compression_config
+
         self.axis_name = axis_name
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
+        self.compression = as_compression_config(compression)
+        if self.compression is not None and not isinstance(
+            axis_name, (tuple, list)
+        ):
+            raise ValueError(
+                "compression quantizes the DCN leg of a hierarchical "
+                "reduce: pass axis_name=(dcn_axis, ici_axis)"
+            )
 
-    def __call__(self, grads: Any) -> Any:
+    def __call__(self, grads: Any,
+                 comm_state: Optional[dict] = None) -> Any:
         return all_reduce_gradients(
             grads,
             axis_name=self.axis_name,
             gradient_average=self.gradient_average,
             gradient_predivide_factor=self.gradient_predivide_factor,
             allreduce_always_fp32=self.allreduce_always_fp32,
+            compression=self.compression,
+            comm_state=comm_state,
         )
+
+    def init_comm_state(self, params: Any,
+                        mesh: Optional[Mesh] = None,
+                        param_specs: Any = None) -> dict:
+        """Zero error-feedback state for :meth:`__call__` — host-side
+        global arrays with ``mesh`` given (place with
+        :meth:`comm_state_specs`), per-device inside shard_map
+        otherwise.  Pass ``param_specs`` when params are sharded over
+        model axes so residuals are sized from per-device shapes."""
+        return init_comm_state(
+            params, self.axis_name, self.compression, mesh=mesh,
+            param_specs=param_specs,
+        )
+
+    def comm_state_specs(self, comm_state: dict,
+                         param_specs: Any = None) -> dict:
+        return comm_state_specs(comm_state, self.axis_name,
+                                param_specs=param_specs)
 
     def value_and_grad(
         self,
@@ -275,31 +544,60 @@ class Reducer:
         gradient_predivide_factor: float = 1.0,
         allreduce_always_fp32: bool = False,
         average_over_microbatches: bool = True,
+        compression: Any = None,
     ):
+        from apex_tpu.ops.quantization import as_compression_config
+
         self.axis_name = axis_name
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.average_over_microbatches = average_over_microbatches
+        # quantize the DCN leg of the deferred reduce (hierarchical
+        # axis pairs only); the error-feedback residual rides the
+        # accumulator state dict as state["comm"] and PERSISTS across
+        # reduce() cycles — only "sum"/"count" reset
+        self.compression = as_compression_config(compression)
+        if self.compression is not None and not isinstance(
+            axis_name, (tuple, list)
+        ):
+            raise ValueError(
+                "compression quantizes the DCN leg of a hierarchical "
+                "reduce: pass axis_name=(dcn_axis, ici_axis)"
+            )
 
     def init(self, params: Any) -> dict:
         """Zero accumulator state (fp32 buffers — accumulation across
-        microbatches in bf16 loses low-order contributions)."""
-        return {
+        microbatches in bf16 loses low-order contributions).  With
+        compression + error feedback the state also carries the
+        quantization residuals (``"comm"``); init must then run inside
+        shard_map (residual shapes come from the bound axis sizes)."""
+        state = {
             "sum": jax.tree.map(
                 lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params
             ),
             "count": jnp.zeros((), jnp.int32),
         }
+        if self.compression is not None and (
+            self.compression.error_feedback
+            or self.compression.rounding == "stochastic"
+        ):
+            state["comm"] = init_comm_state(
+                params, self.axis_name, self.compression
+            )
+        return state
 
     def accumulate(self, state: dict, grads: Any) -> dict:
         """Add one microbatch's grads locally — no collective runs."""
-        return {
+        new = {
             "sum": jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), state["sum"], grads
             ),
             "count": state["count"] + 1,
         }
+        if "comm" in state:
+            new["comm"] = state["comm"]
+        return new
 
     def reduce(self, state: dict) -> tuple:
         """One collective over everything accumulated; returns
@@ -312,15 +610,22 @@ class Reducer:
             grads = jax.tree.map(lambda a: a / n, state["sum"])
         else:
             grads = state["sum"]
-        grads = all_reduce_gradients(
+        comm = state.get("comm")
+        out = all_reduce_gradients(
             grads,
             axis_name=self.axis_name,
             gradient_average=self.gradient_average,
             gradient_predivide_factor=self.gradient_predivide_factor,
             allreduce_always_fp32=self.allreduce_always_fp32,
+            compression=self.compression,
+            comm_state=comm,
         )
         fresh = {
             "sum": jax.tree.map(jnp.zeros_like, state["sum"]),
             "count": jnp.zeros((), jnp.int32),
         }
+        if comm is not None:
+            grads, fresh["comm"] = out
+        else:
+            grads = out
         return grads, fresh

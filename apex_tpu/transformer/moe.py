@@ -34,6 +34,7 @@ from apex_tpu.transformer.parallel_state import (
     DATA_PARALLEL_AXIS,
     TENSOR_PARALLEL_AXIS,
 )
+from apex_tpu._compat import axis_size as _axis_size
 
 __all__ = ["MoEMLP"]
 
@@ -126,7 +127,7 @@ class MoEMLP:
         n = b * s
         E = self.num_experts
         k = self.top_k
-        ep = lax.axis_size(self.ep_axis)
+        ep = _axis_size(self.ep_axis)
         e_local = E // ep
         # expected assignments per expert: k*n/E (each token makes k
         # choices — GShard/ST-MoE convention)

@@ -40,7 +40,11 @@ __all__ = [
     "destroy_model_parallel",
     "get_mesh",
     "spec_axis_names",
+    "data_parallel_axis_names",
+    "hierarchical_data_parallel_axes",
     "DATA_PARALLEL_AXIS",
+    "DATA_PARALLEL_DCN_AXIS",
+    "DATA_PARALLEL_ICI_AXIS",
     "PIPELINE_PARALLEL_AXIS",
     "CONTEXT_PARALLEL_AXIS",
     "TENSOR_PARALLEL_AXIS",
@@ -70,6 +74,12 @@ DATA_PARALLEL_AXIS = "dp"
 PIPELINE_PARALLEL_AXIS = "pp"
 CONTEXT_PARALLEL_AXIS = "cp"
 TENSOR_PARALLEL_AXIS = "tp"
+# hierarchical data parallelism (initialize_model_parallel with
+# data_parallel_ici_size_): the data extent is split into a slow
+# inter-slice axis and a fast intra-slice axis; "dp" stays in the mesh
+# at size 1 so model-internal dp collectives remain valid no-ops
+DATA_PARALLEL_DCN_AXIS = "dcn"
+DATA_PARALLEL_ICI_AXIS = "ici"
 
 _MESH: Optional[Mesh] = None
 _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
@@ -86,6 +96,7 @@ def initialize_model_parallel(
     context_parallel_size_: int = 1,
     pipeline_model_parallel_split_rank_: Optional[int] = None,
     *,
+    data_parallel_ici_size_: Optional[int] = None,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     """Build and install the global 4-D mesh.
@@ -94,6 +105,18 @@ def initialize_model_parallel(
     (reference: apex/transformer/parallel_state.py:58-107): the world size
     must be divisible by tp*pp*cp and dp is the quotient.  Returns the
     mesh; also installs it as the module-global so the getters work.
+
+    ``data_parallel_ici_size_`` splits the data extent into a two-level
+    hierarchy for compressed/hierarchical gradient collectives
+    (``apex_tpu.parallel.all_reduce_gradients`` with
+    ``axis_name=("dcn", "ici")``): the mesh becomes
+    ``("dcn", "ici", "dp", "pp", "cp", "tp")`` with ``ici_size``
+    data replicas per fast-interconnect group, the rest across the slow
+    "dcn" axis, and the "dp" axis kept at size 1 so every model-internal
+    ``psum/pmean`` over "dp" stays a valid no-op.  Shard data over
+    ``data_parallel_axis_names()`` and reduce gradients over
+    ``hierarchical_data_parallel_axes()``.  Expert parallelism (MoE
+    experts riding "dp") is incompatible with the size-1 dummy axis.
     """
     global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
@@ -139,6 +162,30 @@ def initialize_model_parallel(
                 f"the pipeline (size {pp})"
             )
     _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = pipeline_model_parallel_split_rank_
+
+    if data_parallel_ici_size_ is not None:
+        ici = data_parallel_ici_size_
+        if ici < 1 or dp % ici != 0:
+            raise RuntimeError(
+                f"data extent ({dp}) is not divisible by "
+                f"data_parallel_ici_size_ ({ici})"
+            )
+        # data outermost (dcn spans slices, ici rides fast links inside
+        # one), dummy dp=1 next so specs/collectives over "dp" stay
+        # valid, model axes innermost exactly as in the flat layout
+        grid = np.asarray(devices).reshape(dp // ici, ici, 1, pp, cp, tp)
+        _MESH = Mesh(
+            grid,
+            (
+                DATA_PARALLEL_DCN_AXIS,
+                DATA_PARALLEL_ICI_AXIS,
+                DATA_PARALLEL_AXIS,
+                PIPELINE_PARALLEL_AXIS,
+                CONTEXT_PARALLEL_AXIS,
+                TENSOR_PARALLEL_AXIS,
+            ),
+        )
+        return _MESH
 
     grid = np.asarray(devices).reshape(dp, pp, cp, tp)
     _MESH = Mesh(
@@ -207,8 +254,28 @@ def get_pipeline_model_parallel_world_size() -> int:
     return _axis_size(PIPELINE_PARALLEL_AXIS)
 
 
+def hierarchical_data_parallel_axes():
+    """``("dcn", "ici")`` when the mesh was built with
+    ``data_parallel_ici_size_`` (pass as ``axis_name`` to the
+    hierarchical/compressed gradient collectives), else None."""
+    if DATA_PARALLEL_DCN_AXIS in get_mesh().axis_names:
+        return (DATA_PARALLEL_DCN_AXIS, DATA_PARALLEL_ICI_AXIS)
+    return None
+
+
+def data_parallel_axis_names():
+    """Mesh axes the batch shards over — ``("dp",)`` for the flat
+    layout, ``("dcn", "ici")`` for the hierarchical one (use as a
+    ``PartitionSpec`` entry and for loss ``pmean``\\ s)."""
+    hier = hierarchical_data_parallel_axes()
+    return hier if hier is not None else (DATA_PARALLEL_AXIS,)
+
+
 def get_data_parallel_world_size() -> int:
-    return _axis_size(DATA_PARALLEL_AXIS)
+    size = 1
+    for ax in data_parallel_axis_names():
+        size *= _axis_size(ax)
+    return size
 
 
 def get_context_parallel_world_size() -> int:
@@ -228,7 +295,13 @@ def get_pipeline_model_parallel_rank():
 
 
 def get_data_parallel_rank():
-    return jax.lax.axis_index(DATA_PARALLEL_AXIS)
+    axes = data_parallel_axis_names()
+    if len(axes) == 1:
+        return jax.lax.axis_index(axes[0])
+    # hierarchical: linearized (dcn, ici) rank, dcn-major like the grid
+    dcn, ici = axes
+    return (jax.lax.axis_index(dcn) * _axis_size(ici)
+            + jax.lax.axis_index(ici))
 
 
 def get_context_parallel_rank():

@@ -41,6 +41,7 @@ from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
     send_forward,
     send_forward_recv_backward,
 )
+from apex_tpu._compat import axis_size as _axis_size, pcast as _pcast
 
 __all__ = [
     "pipeline",
@@ -67,7 +68,7 @@ def _ensure_varying(tree: Any, axis_name: str) -> Any:
                 return x
         except Exception:
             pass
-        return lax.pcast(x, axis_name, to="varying")
+        return _pcast(x, axis_name, to="varying")
 
     return jax.tree.map(cast, tree)
 
@@ -93,7 +94,7 @@ def _cast_varying(tree: Any, axes: set) -> Any:
         except AttributeError:
             have = set()
         for ax in sorted(axes - have):
-            x = lax.pcast(x, ax, to="varying")
+            x = _pcast(x, ax, to="varying")
         return x
 
     return jax.tree.map(cast, tree)
@@ -196,7 +197,7 @@ def pipeline(
     replicated over the pipeline axis.  Differentiate through this for
     the backward pipeline.
     """
-    pp = lax.axis_size(axis_name)
+    pp = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     num_micro = jax.tree.leaves(microbatches)[0].shape[0]
     ticks = num_micro + pp - 1
@@ -404,7 +405,7 @@ def pipeline_1f1b(
     Returns ``(losses, grads)``: the (M,) per-microbatch losses
     (replicated over the pipeline axis) and ``d(mean losses)/d params``.
     """
-    pp = lax.axis_size(axis_name)
+    pp = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     num_micro = jax.tree.leaves(microbatches)[0].shape[0]
     ticks = num_micro + 2 * pp - 2
@@ -560,7 +561,7 @@ def pipeline_1f1b_interleaved(
 
     Returns ``(losses, grads)`` exactly like :func:`pipeline_1f1b`.
     """
-    pp = lax.axis_size(axis_name)
+    pp = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     V = num_model_chunks
     num_micro = jax.tree.leaves(microbatches)[0].shape[0]
@@ -699,7 +700,7 @@ def pipeline_encdec(
     microbatch after the ring scan.  Differentiate through the result
     for the reverse pipeline.
     """
-    pp = lax.axis_size(axis_name)
+    pp = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     if not (1 <= split_stage < pp):
         raise ValueError(
@@ -812,7 +813,7 @@ def pipeline_encdec_fused(
     microbatch after the scan.  Differentiate through the result for
     the reverse pipeline.
     """
-    pp = lax.axis_size(axis_name)
+    pp = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     if not (1 <= split_stage < pp):
         raise ValueError(
@@ -916,7 +917,7 @@ def pipeline_encdec_fused_1f1b(
     with grads = d(mean losses)/d params, shard-local in the data axes,
     shared-param pp-sync NOT yet applied.
     """
-    pp = lax.axis_size(axis_name)
+    pp = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     if not (1 <= split_stage < pp):
         raise ValueError(
@@ -1139,7 +1140,7 @@ def forward_backward_pipelining_with_interleaving(
       :func:`pipeline`.
     Returns per-microbatch ``last_fn`` results, replicated over pp.
     """
-    pp = lax.axis_size(axis_name)
+    pp = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     V = num_model_chunks
     num_micro = jax.tree.leaves(microbatches)[0].shape[0]

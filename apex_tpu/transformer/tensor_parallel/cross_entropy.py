@@ -21,6 +21,7 @@ from jax import lax
 
 from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
 from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
+from apex_tpu._compat import axis_size as _axis_size, pcast as _pcast
 
 __all__ = [
     "vocab_parallel_cross_entropy",
@@ -121,7 +122,7 @@ def vocab_parallel_cross_entropy(
     Returns (...) float32 losses.
     """
     logits = vocab_parallel_logits.astype(jnp.float32)
-    world = jax.lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     per = logits.shape[-1]
     start, end = VocabUtility.vocab_range_from_per_partition_vocab_size(
@@ -182,12 +183,12 @@ def _varying_like(arr, axis_name, *refs):
     except AttributeError:
         have = set()
     for ax in sorted(need - have):
-        arr = lax.pcast(arr, ax, to="varying")
+        arr = _pcast(arr, ax, to="varying")
     return arr
 
 
 def _vocab_range(weight, axis_name):
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     return VocabUtility.vocab_range_from_per_partition_vocab_size(
         weight.shape[0], rank, world
@@ -256,7 +257,7 @@ def _ce_fwd_scan(x, weight, bias, target, axis_name, chunk, smoothing):
         # semantics): loss = lse - (1-s)*target - s*mean(logits).
         # One stacked psum carries both the target logit and the logit
         # sum, keeping the collective count at three.
-        vocab_global = weight.shape[0] * lax.axis_size(axis_name)
+        vocab_global = weight.shape[0] * _axis_size(axis_name)
         target_logit, sl_g = lax.psum(
             jnp.stack([picked, sl]), axis_name
         )
@@ -283,7 +284,7 @@ def _ce_bwd(axis_name, chunk, smoothing, residuals, g):
     recomputed, never stored); dx accumulates across chunks, dW stacks."""
     x, weight, bias, local_target, in_range, global_max, sum_exp = residuals
     num_chunks = weight.shape[0] // chunk
-    vocab_global = weight.shape[0] * lax.axis_size(axis_name)
+    vocab_global = weight.shape[0] * _axis_size(axis_name)
     gf = g.astype(jnp.float32)
 
     def body(dx, c):

@@ -38,6 +38,7 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
     reduce_from_tensor_model_parallel_region,
     scatter_to_tensor_model_parallel_region,
 )
+from apex_tpu._compat import axis_size as _axis_size
 
 __all__ = ["ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding", "state_specs_like"]
 
@@ -243,7 +244,7 @@ class VocabParallelEmbedding:
 
     def apply(self, params: Dict[str, jnp.ndarray], ids: jnp.ndarray) -> jnp.ndarray:
         w = params["weight"]
-        world = jax.lax.axis_size(self.axis_name)
+        world = _axis_size(self.axis_name)
         rank = jax.lax.axis_index(self.axis_name)
         start, end = VocabUtility.vocab_range_from_per_partition_vocab_size(
             self.num_embeddings // world, rank, world

@@ -337,8 +337,11 @@ def child_gpt(platform: str):
         "unit": "tokens/s",
         # matched-batch comparison isolates the fast-path changes (bf16 +
         # flash + fused masters); batch-size scaling is reported via
-        # value@best_batch separately
-        "vs_baseline": round((fast_matched or fast) / base, 3),
+        # value@best_batch separately.  CPU fallback: null, not a
+        # number — bf16 has no CPU matrix units, so a ratio measured
+        # there would misrepresent TPU (the note carries the why)
+        "vs_baseline": (round((fast_matched or fast) / base, 3)
+                        if on_tpu else None),
         "platform": platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", ""),
         "mfu": mfu,
@@ -965,7 +968,8 @@ def main():
                 "metric": "gpt_tp1_tokens_per_sec",
                 "value": 0.0,
                 "unit": "tokens/s",
-                "vs_baseline": 0.0,
+                # no measurement happened: null, not a fake ratio
+                "vs_baseline": None,
                 "error": "; ".join(errors)[-800:],
             }
             last = _load_last_tpu()

@@ -61,11 +61,33 @@ def main(argv=None):
     ap.add_argument("--eval-batches", type=int, default=4)
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--opt-level", default="O4")
+    ap.add_argument("--dp-ici-size", type=int, default=None,
+                    help="hierarchical data parallelism: replicas per "
+                         "fast-interconnect group (grad reduces run "
+                         "RS(ici)->AR(dcn)->AG(ici))")
+    ap.add_argument("--grad-compression", default="none",
+                    choices=["none", "int8"],
+                    help="int8-quantize the DCN leg of the hierarchical "
+                         "gradient reduce (requires --dp-ici-size)")
+    ap.add_argument("--no-error-feedback", action="store_true")
     args = ap.parse_args(argv)
 
+    hier = args.dp_ici_size is not None
+    if args.grad_compression != "none" and not hier:
+        ap.error("--grad-compression requires --dp-ici-size")
+    comp = None
+    if args.grad_compression != "none":
+        from apex_tpu.ops.quantization import CompressionConfig
+
+        comp = CompressionConfig(
+            method=args.grad_compression,
+            error_feedback=not args.no_error_feedback,
+        )
     mesh = parallel_state.initialize_model_parallel(
-        tensor_model_parallel_size_=args.tp)
-    dp = mesh.shape["dp"]
+        tensor_model_parallel_size_=args.tp,
+        data_parallel_ici_size_=args.dp_ici_size)
+    data_axes = parallel_state.data_parallel_axis_names()
+    dp = parallel_state.get_data_parallel_world_size()
     mp = amp.initialize(opt_level=args.opt_level)
     cfg = BertConfig(
         vocab_size=args.vocab, num_layers=args.layers,
@@ -87,22 +109,49 @@ def main(argv=None):
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, labels[:, None], 1)[:, 0]
         acc = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
-        return (jax.lax.pmean(jnp.mean(nll), "dp"),
-                jax.lax.pmean(jnp.mean(acc), "dp"))
+        return (jax.lax.pmean(jnp.mean(nll), data_axes),
+                jax.lax.pmean(jnp.mean(acc), data_axes))
 
-    def train_step(p, s, tokens, mask, labels):
+    # error-feedback residual state for the compressed reduce
+    use_comm = comp is not None and comp.error_feedback
+    if use_comm:
+        from apex_tpu.parallel.distributed import (
+            comm_state_specs,
+            init_comm_state,
+        )
+
+        comm_state = init_comm_state(params, data_axes, comp, mesh=mesh,
+                                 param_specs=specs)
+        comm_specs = comm_state_specs(comm_state, data_axes,
+                                      param_specs=specs)
+    else:
+        comm_state, comm_specs = {}, {}
+
+    def train_step(p, s, comm, tokens, mask, labels):
         (loss, acc), grads = jax.value_and_grad(
             cls_loss, has_aux=True)(p, tokens, mask, labels)
-        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
-        p, s = opt.step(s, grads, p)
-        return p, s, loss, acc
+        if hier:
+            from apex_tpu.parallel import all_reduce_gradients
 
-    data_spec = P("dp")
+            if use_comm:
+                grads, comm = all_reduce_gradients(
+                    grads, axis_name=data_axes, compression=comp,
+                    comm_state=comm)
+            else:
+                grads = all_reduce_gradients(
+                    grads, axis_name=data_axes, compression=comp)
+        else:
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+        p, s = opt.step(s, grads, p)
+        return p, s, comm, loss, acc
+
+    data_spec = P(data_axes if hier else "dp")
     jstep = jax.jit(
         jax.shard_map(
             train_step, mesh=mesh,
-            in_specs=(specs, opt_specs, data_spec, data_spec, data_spec),
-            out_specs=(specs, opt_specs, P(), P()),
+            in_specs=(specs, opt_specs, comm_specs,
+                      data_spec, data_spec, data_spec),
+            out_specs=(specs, opt_specs, comm_specs, P(), P()),
         ),
         donate_argnums=(0, 1),
     )
@@ -116,6 +165,7 @@ def main(argv=None):
         t, jax.tree.map(lambda s: NamedSharding(mesh, s), sp,
                         is_leaf=lambda x: isinstance(x, P)))
     p, s = place(params, specs), place(opt_state, opt_specs)
+    cst = place(comm_state, comm_specs)
     global_batch = args.batch * dp
     rng = np.random.default_rng(0)
     # pool large enough that most of the vocab appears in position 0,
@@ -129,7 +179,7 @@ def main(argv=None):
     t0, timed = None, 0
     for i in range(args.steps):
         tokens, mask, labels = train_pool[i % len(train_pool)]
-        p, s, loss, acc = jstep(p, s, tokens, mask, labels)
+        p, s, cst, loss, acc = jstep(p, s, cst, tokens, mask, labels)
         lv = float(loss)
         if i == 0:
             t0 = time.perf_counter()

@@ -99,6 +99,24 @@ def main(argv=None):
     ap.add_argument("--zero", action="store_true",
                     help="shard optimizer state over dp "
                          "(DistributedFusedAdam)")
+    ap.add_argument("--dp-ici-size", type=int, default=None,
+                    help="split data parallelism into a (dcn, ici) "
+                         "hierarchy with this many replicas per "
+                         "fast-interconnect group; gradient reduces "
+                         "then run RS(ici)->AR(dcn)->AG(ici) so only "
+                         "1/ici of the bytes cross the slow axis")
+    ap.add_argument("--grad-compression", default="none",
+                    choices=["none", "int8"],
+                    help="quantize the DCN leg of the hierarchical "
+                         "gradient reduce (requires --dp-ici-size); "
+                         "ICI legs and gradient dtypes are untouched")
+    ap.add_argument("--compression-block", type=int, default=256,
+                    help="elements per fp32 scale in the quantized leg")
+    ap.add_argument("--compression-rounding", default="nearest",
+                    choices=["nearest", "stochastic"])
+    ap.add_argument("--no-error-feedback", action="store_true",
+                    help="drop the quantization-residual compensation "
+                         "state (lossier; mainly for A/B experiments)")
     ap.add_argument("--num-experts", type=int, default=None,
                     help="Switch-MoE experts riding dp as the ep axis")
     ap.add_argument("--position-embedding", default="learned",
@@ -118,11 +136,31 @@ def main(argv=None):
     ap.add_argument("--save-every", type=int, default=25)
     args = ap.parse_args(argv)
 
+    hier = args.dp_ici_size is not None
+    if args.grad_compression != "none" and not hier:
+        ap.error("--grad-compression quantizes the DCN leg of the "
+                 "hierarchical reduce: it requires --dp-ici-size")
+    if hier and args.num_experts:
+        ap.error("--dp-ici-size is incompatible with --num-experts "
+                 "(experts ride the dp axis, which the hierarchical "
+                 "layout keeps at size 1)")
+    comp = None
+    if args.grad_compression != "none":
+        from apex_tpu.ops.quantization import CompressionConfig
+
+        comp = CompressionConfig(
+            method=args.grad_compression,
+            block_size=args.compression_block,
+            rounding=args.compression_rounding,
+            error_feedback=not args.no_error_feedback,
+        )
     mesh = parallel_state.initialize_model_parallel(
         tensor_model_parallel_size_=args.tp,
         pipeline_model_parallel_size_=args.pp,
+        data_parallel_ici_size_=args.dp_ici_size,
     )
-    dp = mesh.shape["dp"]
+    data_axes = parallel_state.data_parallel_axis_names()
+    dp = parallel_state.get_data_parallel_world_size()
     mp = amp.initialize(opt_level=args.opt_level)
     cfg = GPTConfig(
         vocab_size=args.vocab, num_layers=args.layers,
@@ -152,8 +190,15 @@ def main(argv=None):
         )
 
         # param_specs routes MoE expert leaves (dp-sharded as ep)
-        # through the rank-local update instead of the flat RS/AG
-        opt = DistributedFusedAdam(lr=args.lr, param_specs=specs)
+        # through the rank-local update instead of the flat RS/AG.
+        # Hierarchical: RS rides ici, the 1/ici shard all-reduces
+        # across dcn (int8-quantized when --grad-compression is set,
+        # residual state inside the optimizer state)
+        opt = DistributedFusedAdam(
+            lr=args.lr, param_specs=specs,
+            axis_name=data_axes if hier else "dp",
+            compression=comp,
+        )
         opt_specs = opt.state_specs(model_axes=("pp", "tp"))
         init_opt = jax.jit(jax.shard_map(
             opt.init, mesh=mesh, in_specs=(specs,), out_specs=opt_specs))
@@ -163,7 +208,28 @@ def main(argv=None):
         opt_state = opt.init(params)
         opt_specs = state_specs_like(specs, opt_state)
 
-    def train_step(params, opt_state, amp_state, tokens, targets):
+    # comm state for the compressed DDP reduce: error-feedback
+    # residuals, and the step counter stochastic rounding derives its
+    # per-step key from (ZeRO carries its own inside the optimizer
+    # state)
+    use_comm = (comp is not None and not args.zero
+                and (comp.error_feedback
+                     or comp.rounding == "stochastic"))
+    if use_comm:
+        from apex_tpu.parallel.distributed import (
+            comm_state_specs,
+            init_comm_state,
+        )
+
+        comm_state = init_comm_state(params, data_axes, comp, mesh=mesh,
+                                 param_specs=specs)
+        comm_specs = comm_state_specs(comm_state, data_axes,
+                                      param_specs=specs)
+    else:
+        comm_state, comm_specs = {}, {}
+
+    def train_step(params, opt_state, amp_state, comm_state,
+                   tokens, targets):
         if pp_path:
             loss, grads = model.pipeline_1f1b_grads(
                 params, tokens, targets, args.num_micro)
@@ -184,7 +250,7 @@ def main(argv=None):
 
             grads, loss = jax.grad(loss_fn, has_aux=True)(params)
             loss = jax.lax.pmean(loss, "dp")
-            if not args.zero:
+            if not args.zero and not hier:
                 # spec-aware dp sync: replicated leaves pmean (a no-op
                 # re-establishing invariance — model.loss's internal
                 # pmean already made their grads globally complete);
@@ -201,18 +267,50 @@ def main(argv=None):
                                    else jax.lax.pmean(g, "dp")),
                     grads, specs,
                 )
+        if hier:
+            # the dummy "dp" axis made every model-internal dp reduce a
+            # no-op: the data-axis loss mean happens here instead
+            loss = jax.lax.pmean(loss, data_axes)
         if use_scaler:
             # MoE: expert grads differ per dp rank, so the overflow
             # verdict must ALSO reach dp consensus or ranks would skip
-            # steps independently and desync replicated params
-            axes = (("tp", "pp", "dp") if args.num_experts
-                    else ("tp", "pp"))
+            # steps independently and desync replicated params.
+            # Hierarchical: grads are not data-synced until after the
+            # unscale (below), so the verdict must span the data axes —
+            # doubly so with compression, which scrambles infs
+            axes = ("tp", "pp")
+            if args.num_experts:
+                axes += ("dp",)
+            if hier:
+                axes += data_axes
             grads, finite, amp_state = mp.unscale_and_adjust(
                 amp_state, grads,
                 finite_reduce=lambda f: model_parallel_all_finite(
                     f, axis_names=axes))
         else:
             finite = None
+        new_comm = comm_state
+        if hier and not args.zero:
+            # data sync AFTER the unscale: the compressed reduce sees
+            # true-magnitude grads (the error-feedback residual is then
+            # consistent across dynamic loss-scale changes), RS rides
+            # ici, only the 1/ici chunk crosses dcn (int8 + fp32
+            # scales when compressed)
+            from apex_tpu.parallel import all_reduce_gradients
+
+            if use_comm:
+                grads, new_comm = all_reduce_gradients(
+                    grads, axis_name=data_axes, compression=comp,
+                    comm_state=comm_state)
+                if finite is not None:
+                    # a skipped (overflowed) step must not absorb
+                    # garbage into the residual
+                    from apex_tpu.optimizers.base import tree_where
+
+                    new_comm = tree_where(finite, new_comm, comm_state)
+            else:
+                grads = all_reduce_gradients(
+                    grads, axis_name=data_axes, compression=comp)
         if args.clip_grad is not None:
             # AFTER unscale (clip sees true-magnitude grads), BEFORE the
             # optimizer; duplicate-aware over the mesh (tp/pp shards +
@@ -232,15 +330,16 @@ def main(argv=None):
         else:
             new_params, new_opt = opt.step(
                 opt_state, grads, params, grads_finite=finite)
-        return new_params, new_opt, amp_state, loss
+        return new_params, new_opt, amp_state, new_comm, loss
 
     amp_specs = jax.tree.map(lambda _: P(), amp_state)
-    data_spec = P("dp")
+    data_spec = P(data_axes if hier else "dp")
     step = jax.jit(
         jax.shard_map(
             train_step, mesh=mesh,
-            in_specs=(specs, opt_specs, amp_specs, data_spec, data_spec),
-            out_specs=(specs, opt_specs, amp_specs, P()),
+            in_specs=(specs, opt_specs, amp_specs, comm_specs,
+                      data_spec, data_spec),
+            out_specs=(specs, opt_specs, amp_specs, comm_specs, P()),
         ),
         donate_argnums=(0, 1),
     )
@@ -257,6 +356,10 @@ def main(argv=None):
         if restored is not None:
             placed = place(restored["params"], specs)
             amp_state = mp.load_state_dict(restored["amp"])
+            if use_comm and "comm" in restored:
+                # resumed error-feedback residuals keep the
+                # quantization compensation instead of re-zeroing it
+                comm_state = restored["comm"]
             start += 1  # the saved step already ran
             print(f"resuming after step {start - 1}")
     # optimizer state AFTER the resume decision, so a restored run
@@ -270,6 +373,7 @@ def main(argv=None):
                      if restored is not None and "opt" in restored
                      else place(opt_state, opt_specs))
 
+    comm_state = place(comm_state, comm_specs)
     global_batch = args.micro_batch * args.num_micro * dp
     pool = (file_batches(args.data, 8, global_batch, args.seq, args.vocab)
             if args.data else
@@ -278,8 +382,8 @@ def main(argv=None):
     t0, timed, lv = None, 0, float("nan")
     for i in range(start, args.steps):
         tokens, targets = pool[i % len(pool)]
-        placed, opt_state, amp_state, loss = step(
-            placed, opt_state, amp_state, tokens, targets)
+        placed, opt_state, amp_state, comm_state, loss = step(
+            placed, opt_state, amp_state, comm_state, tokens, targets)
         lv = float(loss)  # host sync closes the step
         if i == start:
             t0 = time.perf_counter()
@@ -297,6 +401,8 @@ def main(argv=None):
                          "opt": jax.device_get(opt_state),
                          "amp": mp.state_dict(amp_state),
                          "step": np.int64(i)}
+                if use_comm:
+                    state["comm"] = jax.device_get(comm_state)
                 saved = ar.maybe_save(i, state,
                                       force=(i == args.steps - 1))
                 if saved and ar.termination_requested():

@@ -12,7 +12,11 @@ Runs anywhere: real TPU chips or virtual CPU devices
 (XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu).
 
     python examples/t5_pipeline.py
+    # hierarchical dp with an int8-compressed DCN leg:
+    python examples/t5_pipeline.py --dp-ici-size 2 --grad-compression int8
 """
+
+import argparse
 
 import jax
 import jax.numpy as jnp
@@ -27,19 +31,47 @@ VOCAB = 128
 STEPS = 60
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp-ici-size", type=int, default=None,
+                    help="hierarchical data parallelism: replicas per "
+                         "fast-interconnect group")
+    ap.add_argument("--grad-compression", default="none",
+                    choices=["none", "int8"],
+                    help="int8-quantize the DCN leg of the hierarchical "
+                         "gradient reduce (requires --dp-ici-size)")
+    ap.add_argument("--no-error-feedback", action="store_true")
+    args = ap.parse_args(argv)
+
+    hier = args.dp_ici_size is not None
+    if args.grad_compression != "none" and not hier:
+        ap.error("--grad-compression requires --dp-ici-size")
+    comp = None
+    if args.grad_compression != "none":
+        from apex_tpu.ops.quantization import CompressionConfig
+
+        comp = CompressionConfig(
+            method=args.grad_compression,
+            error_feedback=not args.no_error_feedback,
+        )
+
     n = jax.device_count()
     pp = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
     if pp < 2:
         raise SystemExit("need >= 2 devices for a pipeline split "
                          "(set XLA_FLAGS=--xla_force_host_platform_"
                          "device_count=8 JAX_PLATFORMS=cpu)")
+    if hier and n // pp % args.dp_ici_size:
+        raise SystemExit(f"data extent {n // pp} is not divisible by "
+                         f"--dp-ici-size {args.dp_ici_size}")
     split = pp // 2
     mesh = parallel_state.initialize_model_parallel(
         pipeline_model_parallel_size_=pp,
         pipeline_model_parallel_split_rank_=split,
+        data_parallel_ici_size_=args.dp_ici_size,
     )
-    dp = mesh.shape["dp"]
+    data_axes = parallel_state.data_parallel_axis_names()
+    dp = parallel_state.get_data_parallel_world_size()
     print(f"devices={n} pp={pp} (enc stages {split}, dec {pp - split}) dp={dp}")
 
     model = T5Model(T5Config(
@@ -59,22 +91,53 @@ def main():
     opt_state = opt.init(params)
     opt_specs = state_specs_like(specs, opt_state)
 
-    def train_step(params, opt_state, enc, dec, tgt):
-        # no explicit dp grad-pmean needed: pipeline_loss pmeans the
-        # loss over "dp" internally, so differentiating it inserts the
-        # dp grad reduction automatically (shard_map's replication check
-        # on out_specs would reject divergent updates otherwise)
+    # error-feedback residual state for the compressed reduce
+    use_comm = comp is not None and comp.error_feedback
+    if use_comm:
+        from apex_tpu.parallel.distributed import (
+            comm_state_specs,
+            init_comm_state,
+        )
+
+        comm_state = init_comm_state(params, data_axes, comp, mesh=mesh,
+                                 param_specs=specs)
+        comm_specs = comm_state_specs(comm_state, data_axes,
+                                      param_specs=specs)
+    else:
+        comm_state, comm_specs = {}, {}
+
+    def train_step(params, opt_state, comm, enc, dec, tgt):
+        # flat dp: no explicit grad-pmean needed — pipeline_loss pmeans
+        # the loss over "dp" internally, so differentiating it inserts
+        # the dp grad reduction automatically (shard_map's replication
+        # check on out_specs would reject divergent updates otherwise).
+        # Hierarchical dp: the internal pmean rides the size-1 dummy
+        # axis, so the data mean over (dcn, ici) happens explicitly —
+        # RS(ici) -> AR(dcn, int8 when compressed) -> AG(ici)
         loss, grads = jax.value_and_grad(
             lambda p: model.pipeline_loss(p, enc, dec, tgt,
                                           num_microbatches=2)
         )(params)
-        params, opt_state = opt.step(opt_state, grads, params)
-        return params, opt_state, loss
+        if hier:
+            from apex_tpu.parallel import all_reduce_gradients
 
+            loss = jax.lax.pmean(loss, data_axes)
+            if use_comm:
+                grads, comm = all_reduce_gradients(
+                    grads, axis_name=data_axes, compression=comp,
+                    comm_state=comm)
+            else:
+                grads = all_reduce_gradients(
+                    grads, axis_name=data_axes, compression=comp)
+        params, opt_state = opt.step(opt_state, grads, params)
+        return params, opt_state, comm, loss
+
+    data_spec = P(data_axes if hier else "dp")
     step = jax.jit(jax.shard_map(
         train_step, mesh=mesh,
-        in_specs=(specs, opt_specs, P("dp"), P("dp"), P("dp")),
-        out_specs=(specs, opt_specs, P()),
+        in_specs=(specs, opt_specs, comm_specs,
+                  data_spec, data_spec, data_spec),
+        out_specs=(specs, opt_specs, comm_specs, P()),
     ))
     place = lambda tree, sp: jax.device_put(
         tree, jax.tree.map(lambda s: NamedSharding(mesh, s), sp,
@@ -87,8 +150,9 @@ def main():
     targets = jnp.roll(dec_tokens, -1, axis=1)
 
     p, s = place(params, specs), place(opt_state, opt_specs)
+    cst = place(comm_state, comm_specs)
     for i in range(STEPS):
-        p, s, loss = step(p, s, enc_tokens, dec_tokens, targets)
+        p, s, cst, loss = step(p, s, cst, enc_tokens, dec_tokens, targets)
         if i % 10 == 0 or i == STEPS - 1:
             print(f"step {i:3d}  loss {float(loss):.4f}")
     print("done")
