@@ -47,19 +47,29 @@ def multi_tensor_scale(
     (reference: csrc/multi_tensor_scale_kernel.cu).  Returns
     ``(scaled_tree, overflow)`` where overflow is True if any *input* leaf
     contained inf/nan (the kernel's noop_flag contract: it checks the
-    incoming values it reads).
+    incoming values it reads — a non-finite value INTRODUCED by the
+    multiply, e.g. an inf ``scale``, does not raise the flag, exactly
+    like the CUDA kernel's per-element ``isfinite(r_in[ii])``).
+
+    The finiteness reduction runs on the same fp32 cast the multiply
+    uses (the half-dtype → fp32 cast is exact, so finiteness is
+    preserved), so the jitted op reads each leaf ONCE — the check fuses
+    into the scaling loop instead of a second pass over every input.
     """
+    flags = []
 
     def scale_leaf(l):
-        if not jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating):
+        arr = jnp.asarray(l)
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
             return l
-        out = l.astype(jnp.float32) * scale
-        return out.astype(out_dtype or l.dtype)
+        xf = arr.astype(jnp.float32)
+        flags.append(jnp.all(jnp.isfinite(xf)))
+        out = xf * scale
+        return out.astype(out_dtype or arr.dtype)
 
     scaled = jax.tree.map(scale_leaf, tree)
-    leaves = _float_leaves(tree)
-    if leaves:
-        overflow = ~jnp.stack([jnp.all(jnp.isfinite(l)) for l in leaves]).all()
+    if flags:
+        overflow = ~jnp.stack(flags).all()
     else:
         overflow = jnp.bool_(False)
     return scaled, overflow
@@ -75,16 +85,28 @@ def multi_tensor_axpby(
     """``out = a*x + b*y`` leafwise with an overflow flag
     (reference: csrc/multi_tensor_axpby_kernel.cu) — the kernel behind
     stashed-gradient accumulation in amp
-    (reference: apex/amp/_process_optimizer.py:93-139)."""
+    (reference: apex/amp/_process_optimizer.py:93-139).
+
+    The flag checks the INCOMING x/y values (on the same single fp32
+    read the axpby consumes — one pass per leaf, like
+    :func:`multi_tensor_scale`); non-finite values introduced by the
+    coefficients alone do not raise it."""
+    flags = []
 
     def axpby(x, y):
-        out = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
-        return out.astype(out_dtype or x.dtype)
+        xa, ya = jnp.asarray(x), jnp.asarray(y)
+        xf = xa.astype(jnp.float32)
+        yf = ya.astype(jnp.float32)
+        if jnp.issubdtype(xa.dtype, jnp.floating):
+            flags.append(jnp.all(jnp.isfinite(xf)))
+        if jnp.issubdtype(ya.dtype, jnp.floating):
+            flags.append(jnp.all(jnp.isfinite(yf)))
+        out = a * xf + b * yf
+        return out.astype(out_dtype or xa.dtype)
 
     out = jax.tree.map(axpby, x_tree, y_tree)
-    leaves = _float_leaves(x_tree) + _float_leaves(y_tree)
-    if leaves:
-        overflow = ~jnp.stack([jnp.all(jnp.isfinite(l)) for l in leaves]).all()
+    if flags:
+        overflow = ~jnp.stack(flags).all()
     else:
         overflow = jnp.bool_(False)
     return out, overflow

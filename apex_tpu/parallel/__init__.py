@@ -4,9 +4,14 @@ TPU-native replacement for the reference's NCCL data-parallel layer
 (reference: apex/parallel/).  The translation (SURVEY.md §7):
 
 - ``DistributedDataParallel``'s bucketed, stream-overlapped allreduce
-  → a mesh axis + one ``psum`` of the grad pytree inside the jitted step;
-  XLA's latency-hiding scheduler overlaps the collective with backward
-  compute, which is exactly what the reference's side streams hand-built.
+  → a mesh axis + ``psum`` of the grad pytree inside the jitted step.
+  A lone post-accumulation psum has nothing left to overlap with, so
+  the reference's hand-built side-stream overlap is reproduced
+  explicitly: ``overlap_grad_sync=True`` (:mod:`apex_tpu.parallel.
+  overlap`) buckets grads in backward-ready order and pipelines each
+  microbatch's bucket reduces against the next microbatch's compute,
+  giving XLA's latency-hiding scheduler real work to put between
+  ``all-reduce-start`` and ``-done``.
 - ``SyncBatchNorm``'s Welford kernels → a ``psum`` of (count, Σx, Σx²)
   over the 'dp' axis — Welford merging is unnecessary when the reduction
   is a single fused collective.
@@ -19,6 +24,10 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     all_reduce_gradients,
     data_parallel_mesh,
     hierarchical_data_parallel_mesh,
+)
+from apex_tpu.parallel.overlap import (  # noqa: F401
+    DEFAULT_BUCKET_BYTES,
+    GradientBuckets,
 )
 from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
     SyncBatchNorm,
@@ -36,6 +45,8 @@ __all__ = [
     "all_reduce_gradients",
     "data_parallel_mesh",
     "hierarchical_data_parallel_mesh",
+    "DEFAULT_BUCKET_BYTES",
+    "GradientBuckets",
     "SyncBatchNorm",
     "sync_batch_norm",
     "convert_syncbn_model",

@@ -3,14 +3,31 @@
 The reference DDP (reference: apex/parallel/distributed.py:129-640) does
 four jobs: broadcast params at init, discover grad buckets in backward
 order, allreduce buckets on side streams overlapped with backward, and
-optionally keep flat allreduce buffers for amp.  Under SPMD every one of
-those collapses:
+optionally keep flat allreduce buffers for amp.  Under SPMD:
 
 - param broadcast   → params are replicated by sharding (``NamedSharding``
   with no 'dp' axis in the spec);
-- bucketing/streams → one ``psum`` of the whole grad pytree; XLA chunks
-  and overlaps it with the backward automatically;
-- flat buffers      → jit's problem, not ours.
+- flat buffers      → jit's problem, not ours;
+- bucketing/streams → NOT automatic.  A single ``psum`` of the whole
+  grad pytree issued AFTER the accumulation loop (the deferred
+  ``Reducer`` pattern below) leaves XLA's latency-hiding scheduler no
+  independent compute to hide the collective behind — the whole
+  reduce latency is exposed.  The overlap the reference hand-built
+  with side streams is restored by :mod:`apex_tpu.parallel.overlap`:
+  ``overlap_grad_sync=True`` assembles size-targeted buckets in
+  reverse-layer (backward-ready) order and, in the pipelined
+  accumulate-and-reduce loop, issues microbatch *i*'s bucket reduces
+  while microbatch *i+1*'s fwd/bwd computes, so the scheduler can emit
+  async ``all-reduce-start``/``-done`` pairs with real compute between
+  them.  ``bucket_bytes`` is the TPU analog of the reference's
+  ``message_size``/``allreduce_communicators`` knobs; the trade
+  (per-microbatch reduces cost K× the bytes of one deferred reduce,
+  in exchange for hiding the latency) is documented in
+  docs/distributed.md.  ``overlap_grad_sync=False`` (default) is the
+  unchanged deferred path, and single-shot bucketed reduces at
+  ``compression=None`` are bit-identical to the unbucketed ones
+  (collectives are elementwise — packing changes no per-element
+  summation order).
 
 What survives as *semantics* are the knobs, reproduced here exactly:
 ``gradient_average`` (divide by world size), ``gradient_predivide_factor``
@@ -142,6 +159,8 @@ def all_reduce_gradients(
     allreduce_always_fp32: bool = False,
     compression: Any = None,
     comm_state: Optional[dict] = None,
+    overlap_grad_sync: bool = False,
+    bucket_bytes: Optional[int] = None,
 ) -> Any:
     """psum the grad pytree over ``axis_name`` (call inside shard_map/pmap).
 
@@ -161,6 +180,15 @@ def all_reduce_gradients(
     the call then returns ``(grads, new_comm_state)`` instead of just
     ``grads`` — thread the new state into the next step and checkpoint
     it with the training state.
+
+    ``overlap_grad_sync=True`` reduces size-targeted BUCKETS of leaves
+    (reverse-layer order, ``bucket_bytes`` per bucket — see
+    :mod:`apex_tpu.parallel.overlap`) instead of one collective per
+    leaf, giving the scheduler separately-overlappable collectives; at
+    ``compression=None`` the result is bit-identical to the unbucketed
+    reduce.  With compression the ``comm_state`` must then be BUCKETED
+    too: build it with ``init_comm_state(..., bucket_bytes=...)`` using
+    the same bucket size and leaf dtypes.
 
     Matches the reference's scaling semantics
     (reference: apex/parallel/distributed.py:463-476): grads are divided
@@ -188,6 +216,23 @@ def all_reduce_gradients(
         )
     if comm_state is not None and cfg is None:
         raise ValueError("comm_state given without compression")
+    from apex_tpu.parallel.overlap import is_bucketed_residuals
+
+    bucketed_state = comm_state is not None and is_bucketed_residuals(
+        comm_state["residuals"]
+    )
+    if bucketed_state and not overlap_grad_sync:
+        raise ValueError(
+            "comm state was built with bucket_bytes= (per-bucket "
+            "residuals): pass overlap_grad_sync=True"
+        )
+    if overlap_grad_sync and comm_state is not None \
+            and not bucketed_state:
+        raise ValueError(
+            "overlap_grad_sync with compression needs a BUCKETED "
+            "comm state: build it with init_comm_state(..., "
+            "bucket_bytes=<the same bucket size>)"
+        )
     if hierarchical:
         dcn_axis, ici_axis = axis_name
         world = _axis_size(dcn_axis) * _axis_size(ici_axis)
@@ -218,16 +263,39 @@ def all_reduce_gradients(
             g = g * gradient_predivide_factor
         return g.astype(orig_dtype), new_residual
 
+    from apex_tpu.parallel.overlap import dither_key
+
     def leaf_key(i):
-        """Distinct dither per leaf AND per step — one shared key would
-        correlate the noise across same-shaped leaves."""
-        if cfg is None or cfg.rounding != "stochastic" or step is None:
-            return None
-        return jax.random.fold_in(
-            jax.random.fold_in(jax.random.PRNGKey(0), step), i
-        )
+        return dither_key(cfg, step, i)
 
     leaves, treedef = jax.tree_util.tree_flatten(grads)
+
+    if overlap_grad_sync:
+        from apex_tpu.parallel.overlap import (
+            DEFAULT_BUCKET_BYTES,
+            GradientBuckets,
+            reduce_bucketed,
+        )
+
+        plan = GradientBuckets.for_tree(
+            grads,
+            DEFAULT_BUCKET_BYTES if bucket_bytes is None
+            else bucket_bytes,  # 0 reaches the >=1 validation, not
+        )                       # the default
+        bufs = plan.pack(leaves)
+        if comm_state is None:
+            out, _ = reduce_bucketed(plan, bufs, cfg, None, None, sync)
+            return jax.tree_util.tree_unflatten(
+                treedef, plan.unpack(out, leaves)
+            )
+        _check_bucketed_state(plan, comm_state, cfg, dcn_axis, ici_axis)
+        out_bufs, new_residuals = reduce_bucketed(
+            plan, bufs, cfg, comm_state["residuals"], step, sync
+        )
+        return jax.tree_util.tree_unflatten(
+            treedef, plan.unpack(out_bufs, leaves)
+        ), {"residuals": new_residuals, "step": comm_state["step"] + 1}
+
     if comm_state is None:
         out = [sync(g, None, None)[0] for g in leaves]
         return jax.tree_util.tree_unflatten(treedef, out)
@@ -250,15 +318,56 @@ def all_reduce_gradients(
     ), new_state
 
 
+def _check_bucketed_state(plan, comm_state, cfg, dcn_axis,
+                          ici_axis) -> None:
+    """Fail with an actionable message when the per-bucket residual
+    sizes do not match the trace-time bucket plan (the shapes would
+    otherwise error deep inside quantized_psum)."""
+    from apex_tpu.ops.quantization import comm_residual_sizes
+
+    residuals = comm_state["residuals"]
+    if set(residuals) != set(plan.names):
+        raise ValueError(
+            f"bucketed comm state has {len(residuals)} buckets, the "
+            f"grads bucket into {len(plan.buckets)}: init_comm_state "
+            "must use the same bucket_bytes and see the same leaf "
+            "shapes/dtypes as the reduce"
+        )
+    if not cfg.error_feedback:
+        return
+    dcn, ici = _axis_size(dcn_axis), _axis_size(ici_axis)
+    for name, b in zip(plan.names, plan.buckets):
+        n = b.size
+        chunk = (n + (-n) % ici) // ici
+        padded, _ = comm_residual_sizes(chunk, dcn, cfg.block_size)
+        push = residuals[name]["push"]
+        if push.size != padded:
+            raise ValueError(
+                f"residual '{name}' has {push.size} elements, the "
+                f"bucket's padded chunk is {padded}: init_comm_state "
+                "must use the same bucket_bytes and leaf dtypes as "
+                "the reduce"
+            )
+
+
 def init_comm_state(
     tree: Any,
     axis_name: Tuple[str, str],
     compression: Any = "int8",
     mesh: Optional[Mesh] = None,
     param_specs: Any = None,
+    bucket_bytes: Optional[int] = None,
+    buckets: Any = None,
 ) -> dict:
     """Zero error-feedback state for compressed hierarchical reduces of
     a grad pytree shaped like ``tree``.
+
+    With ``bucket_bytes`` (or a prebuilt ``buckets`` plan) the state is
+    sized for the BUCKETED reduce (``overlap_grad_sync=True``): one
+    push/pull residual pair per bucket instead of per leaf, keyed
+    ``bucket_000``... — pass the SAME bucket size the reduce will use
+    (and, for model-sharded params, the same ``param_specs``) so the
+    host-built plan matches the trace-time one.
 
     Residuals are sized from the PER-DEVICE gradient shapes the reduce
     will see inside shard_map.  For the usual DDP setup (replicated
@@ -283,6 +392,16 @@ def init_comm_state(
     cfg = as_compression_config(compression)
     if cfg is None:
         raise ValueError("init_comm_state needs a compression config")
+    if bucket_bytes is not None or buckets is not None:
+        from apex_tpu.parallel.overlap import (
+            GradientBuckets,
+            bucket_comm_state,
+        )
+
+        plan = buckets or GradientBuckets.for_tree(
+            tree, bucket_bytes, param_specs=param_specs, mesh=mesh
+        )
+        return bucket_comm_state(plan, axis_name, cfg, mesh=mesh)
     dcn_axis, ici_axis = axis_name
     if mesh is not None:
         dcn, ici = mesh.shape[dcn_axis], mesh.shape[ici_axis]
@@ -292,16 +411,13 @@ def init_comm_state(
         replicas = 1
 
     def local_size(leaf, spec) -> int:
-        shape = list(jnp.shape(leaf)) or [1]
-        if mesh is not None and spec is not None:
-            for i, entry in enumerate(spec):
-                if entry is None or i >= len(shape):
-                    continue
-                names = entry if isinstance(entry, tuple) else (entry,)
-                for ax in names:
-                    shape[i] //= mesh.shape[ax]
+        # the ONE per-device-shape derivation, shared with the bucket
+        # plan builder so bucketed and per-leaf residual sizing can
+        # never disagree about what "local" means
+        from apex_tpu.parallel.overlap import _local_shape
+
         n = 1
-        for d in shape:
+        for d in _local_shape(leaf, spec, mesh):
             n *= int(d)
         return n
 
@@ -342,7 +458,8 @@ def _model_axis_extent(spec, mesh: Optional[Mesh]) -> int:
 
 def comm_state_specs(comm_state: dict,
                      axis_name: Tuple[str, str],
-                     param_specs: Any = None) -> dict:
+                     param_specs: Any = None,
+                     buckets: Any = None) -> dict:
     """shard_map / device_put specs for :func:`init_comm_state` output:
     residuals are device-varying over both data axes (sharded along
     axis 0), the step counter is replicated.
@@ -350,8 +467,38 @@ def comm_state_specs(comm_state: dict,
     Pass the same ``param_specs`` given to :func:`init_comm_state` when
     params are sharded over model axes: a pp/tp-sharded leaf's residual
     varies over those axes too, and declaring it replicated there would
-    be rejected (or silently wrong) under shard_map."""
+    be rejected (or silently wrong) under shard_map.  For BUCKETED
+    state over model-sharded params, pass the ``buckets`` plan (built
+    with the same ``param_specs``/``mesh``) instead — each bucket's
+    residual varies over the union of its member leaves' model axes."""
+    from apex_tpu.parallel.overlap import is_bucketed_residuals
+
     dcn_axis, ici_axis = axis_name
+    if is_bucketed_residuals(comm_state.get("residuals")):
+        if buckets is not None:
+            rs = {
+                name: {
+                    "push": P((dcn_axis, ici_axis, *b.model_axes)),
+                    "pull": P((dcn_axis, ici_axis, *b.model_axes)),
+                }
+                for name, b in zip(buckets.names, buckets.buckets)
+            }
+        elif param_specs is not None:
+            # silently emitting P((dcn, ici)) here would mis-shard
+            # residuals whose buckets were sized with model-axis reps
+            raise ValueError(
+                "bucketed comm state over model-sharded params needs "
+                "the bucket plan to spec each bucket's model axes: "
+                "pass buckets=GradientBuckets.for_tree(params, "
+                "bucket_bytes, param_specs=..., mesh=...) — the same "
+                "plan init_comm_state used"
+            )
+        else:
+            rs = jax.tree.map(
+                lambda _: P((dcn_axis, ici_axis)),
+                comm_state["residuals"],
+            )
+        return {"residuals": rs, "step": P()}
     if param_specs is None:
         specs = jax.tree.map(
             lambda _: P((dcn_axis, ici_axis)), comm_state
@@ -388,8 +535,10 @@ class DistributedDataParallel:
         (loss, grads) = grad_fn(params, batch)   # batch sharded over dp
 
     The constructor knobs mirror the reference's
-    (reference: apex/parallel/distributed.py:139-206); the
-    stream/bucket/message-size knobs have no TPU meaning and are
+    (reference: apex/parallel/distributed.py:139-206).  The reference's
+    ``message_size``/stream knobs map to ``overlap_grad_sync=True`` +
+    ``bucket_bytes`` (bucketed reduces the scheduler can overlap — see
+    :mod:`apex_tpu.parallel.overlap`); the legacy spellings are still
     accepted-and-ignored for source compatibility.
 
     ``compression`` (with a hierarchical ``axis_name=(dcn, ici)``
@@ -406,6 +555,8 @@ class DistributedDataParallel:
         gradient_predivide_factor: float = 1.0,
         allreduce_always_fp32: bool = False,
         compression: Any = None,
+        overlap_grad_sync: bool = False,
+        bucket_bytes: Optional[int] = None,
         # accepted for source compat; meaningless under XLA:
         message_size: int = 10000000,
         delay_allreduce: bool = False,
@@ -413,12 +564,18 @@ class DistributedDataParallel:
         retain_allreduce_buffers: bool = False,
     ):
         from apex_tpu.ops.quantization import as_compression_config
+        from apex_tpu.parallel.overlap import DEFAULT_BUCKET_BYTES
 
         self.axis_name = axis_name
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.compression = as_compression_config(compression)
+        self.overlap_grad_sync = overlap_grad_sync
+        self.bucket_bytes = (DEFAULT_BUCKET_BYTES if bucket_bytes is None
+                             else bucket_bytes)
+        if self.bucket_bytes < 1:
+            raise ValueError("bucket_bytes must be >= 1")
         if self.compression is not None and not isinstance(
             axis_name, (tuple, list)
         ):
@@ -437,6 +594,8 @@ class DistributedDataParallel:
             allreduce_always_fp32=self.allreduce_always_fp32,
             compression=self.compression,
             comm_state=comm_state,
+            overlap_grad_sync=self.overlap_grad_sync,
+            bucket_bytes=self.bucket_bytes,
         )
 
     def init_comm_state(self, params: Any,
@@ -446,16 +605,34 @@ class DistributedDataParallel:
         global arrays with ``mesh`` given (place with
         :meth:`comm_state_specs`), per-device inside shard_map
         otherwise.  Pass ``param_specs`` when params are sharded over
-        model axes so residuals are sized from per-device shapes."""
+        model axes so residuals are sized from per-device shapes.
+        With ``overlap_grad_sync`` the state is bucketed to match, and
+        the bucket plan is remembered so :meth:`comm_state_specs` can
+        emit per-bucket model-axis specs without the caller rebuilding
+        it."""
+        if self.overlap_grad_sync:
+            from apex_tpu.parallel.overlap import GradientBuckets
+
+            self._bucket_plan = GradientBuckets.for_tree(
+                params, self.bucket_bytes, param_specs=param_specs,
+                mesh=mesh,
+            )
+            return init_comm_state(
+                params, self.axis_name, self.compression, mesh=mesh,
+                param_specs=param_specs, buckets=self._bucket_plan,
+            )
         return init_comm_state(
             params, self.axis_name, self.compression, mesh=mesh,
             param_specs=param_specs,
         )
 
     def comm_state_specs(self, comm_state: dict,
-                         param_specs: Any = None) -> dict:
-        return comm_state_specs(comm_state, self.axis_name,
-                                param_specs=param_specs)
+                         param_specs: Any = None,
+                         buckets: Any = None) -> dict:
+        return comm_state_specs(
+            comm_state, self.axis_name, param_specs=param_specs,
+            buckets=buckets or getattr(self, "_bucket_plan", None),
+        )
 
     def value_and_grad(
         self,
@@ -535,6 +712,25 @@ class Reducer:
     accepted for signature parity but meaningless here — the
     accumulator is ALWAYS fp32 (see :meth:`init`), so the reduction
     already runs in fp32 regardless.
+
+    ``overlap_grad_sync=True`` switches to the PIPELINED
+    accumulate-and-reduce loop (:mod:`apex_tpu.parallel.overlap`): the
+    state carries the last microbatch's gradients bucketed but
+    un-reduced (``state["pending"]``), and each ``accumulate`` issues
+    the previous microbatch's per-bucket reduces — independent of the
+    new microbatch's fwd/bwd, so the scheduler overlaps them —
+    accumulating the REDUCED sums; ``reduce()`` flushes the final
+    pending microbatch and applies the scaling.  Semantics: the result
+    is ``Σ_k psum(g_k)`` scaled exactly as the deferred
+    ``psum(Σ_k g_k)`` would be — the same mean, a different (per-
+    microbatch) summation order, bit-identical to the deferred path at
+    K=1 and within accumulation rounding for K>1.  Each microbatch's
+    reduce costs wire bytes, so K microbatches move K× the deferred
+    mode's bytes — the reference DDP's own default trade (latency
+    hidden, bytes multiplied); ``compression="int8"`` composes, with
+    per-bucket error-feedback residuals updated every microbatch.  The
+    state stays an ordinary pytree: prime it with one ``accumulate``
+    and the rest of the loop can be a ``lax.scan``.
     """
 
     def __init__(
@@ -545,19 +741,27 @@ class Reducer:
         allreduce_always_fp32: bool = False,
         average_over_microbatches: bool = True,
         compression: Any = None,
+        overlap_grad_sync: bool = False,
+        bucket_bytes: Optional[int] = None,
     ):
         from apex_tpu.ops.quantization import as_compression_config
+        from apex_tpu.parallel.overlap import DEFAULT_BUCKET_BYTES
 
         self.axis_name = axis_name
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.average_over_microbatches = average_over_microbatches
-        # quantize the DCN leg of the deferred reduce (hierarchical
-        # axis pairs only); the error-feedback residual rides the
-        # accumulator state dict as state["comm"] and PERSISTS across
-        # reduce() cycles — only "sum"/"count" reset
+        # quantize the DCN leg of the reduce (hierarchical axis pairs
+        # only); the error-feedback residual rides the accumulator
+        # state dict as state["comm"] and PERSISTS across reduce()
+        # cycles — only "sum"/"count" reset
         self.compression = as_compression_config(compression)
+        self.overlap_grad_sync = overlap_grad_sync
+        self.bucket_bytes = (DEFAULT_BUCKET_BYTES if bucket_bytes is None
+                             else bucket_bytes)
+        if self.bucket_bytes < 1:
+            raise ValueError("bucket_bytes must be >= 1")
         if self.compression is not None and not isinstance(
             axis_name, (tuple, list)
         ):
@@ -566,45 +770,132 @@ class Reducer:
                 "reduce: pass axis_name=(dcn_axis, ici_axis)"
             )
 
+    def _needs_comm_state(self) -> bool:
+        return self.compression is not None and (
+            self.compression.error_feedback
+            or self.compression.rounding == "stochastic"
+        )
+
     def init(self, params: Any) -> dict:
         """Zero accumulator state (fp32 buffers — accumulation across
         microbatches in bf16 loses low-order contributions).  With
         compression + error feedback the state also carries the
-        quantization residuals (``"comm"``); init must then run inside
-        shard_map (residual shapes come from the bound axis sizes)."""
+        quantization residuals (``"comm"``, per BUCKET in overlap
+        mode); init must then run inside shard_map (residual shapes
+        come from the bound axis sizes)."""
         state = {
             "sum": jax.tree.map(
                 lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params
             ),
             "count": jnp.zeros((), jnp.int32),
         }
-        if self.compression is not None and (
-            self.compression.error_feedback
-            or self.compression.rounding == "stochastic"
-        ):
-            state["comm"] = init_comm_state(
-                params, self.axis_name, self.compression
-            )
+        if self._needs_comm_state():
+            if self.overlap_grad_sync:
+                from apex_tpu.parallel.overlap import (
+                    GradientBuckets,
+                    bucket_comm_state,
+                )
+
+                plan = GradientBuckets.for_tree(
+                    params, self.bucket_bytes, dtype=jnp.float32
+                )
+                state["comm"] = bucket_comm_state(
+                    plan, self.axis_name, self.compression
+                )
+            else:
+                state["comm"] = init_comm_state(
+                    params, self.axis_name, self.compression
+                )
         return state
 
     def accumulate(self, state: dict, grads: Any) -> dict:
-        """Add one microbatch's grads locally — no collective runs."""
-        new = {
-            "sum": jax.tree.map(
-                lambda a, g: a + g.astype(jnp.float32), state["sum"], grads
-            ),
-            "count": state["count"] + 1,
-        }
+        """Add one microbatch's grads.  Deferred mode: a local add, no
+        collective.  Overlap mode: the PREVIOUS microbatch's buckets
+        are reduced here (their collectives and this microbatch's
+        fwd/bwd are mutually independent — the scheduler's overlap
+        window) and the new grads become the in-flight ``pending``."""
+        if not self.overlap_grad_sync:
+            new = {
+                "sum": jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32),
+                    state["sum"], grads
+                ),
+                "count": state["count"] + 1,
+            }
+            if "comm" in state:
+                new["comm"] = state["comm"]
+            return new
+        new = {"count": state["count"] + 1, "sum": state["sum"]}
         if "comm" in state:
             new["comm"] = state["comm"]
+        if "pending" in state:
+            reduced, new_comm = self._overlap_reduce_once(
+                state["pending"], state.get("comm")
+            )
+            new["sum"] = jax.tree.map(
+                lambda a, r: a + r, state["sum"], reduced
+            )
+            if new_comm is not None:
+                new["comm"] = new_comm
+        new["pending"] = jax.tree.map(
+            lambda g: jnp.asarray(g).astype(jnp.float32), grads
+        )
         return new
 
+    def _overlap_reduce_once(self, tree: Any, comm: Optional[dict]):
+        """Per-bucket SUM-reduce of one microbatch's fp32 grads:
+        predivide, RS(ici) → AR(dcn, compressed) → AG(ici) per bucket
+        (plain psum on a flat axis).  Averaging is deferred to
+        :meth:`reduce` so the scaling ops match the deferred path's
+        exactly."""
+        from apex_tpu.parallel.overlap import (
+            GradientBuckets,
+            reduce_bucketed,
+        )
+
+        f = self.gradient_predivide_factor
+        cfg = self.compression
+        hierarchical = isinstance(self.axis_name, (tuple, list))
+        plan = GradientBuckets.for_tree(
+            tree, self.bucket_bytes, dtype=jnp.float32
+        )
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        bufs = plan.pack(leaves)
+        step = None if comm is None else comm["step"]
+
+        def reduce_one(buf, residual, key):
+            if f != 1.0:
+                buf = buf / f
+            if hierarchical:
+                dcn_axis, ici_axis = self.axis_name
+                return _hierarchical_psum(
+                    buf, dcn_axis, ici_axis, compression=cfg,
+                    residual=residual, step=step, key=key,
+                )
+            return jax.lax.psum(buf, self.axis_name), None
+
+        out_bufs, new_residuals = reduce_bucketed(
+            plan, bufs, cfg,
+            None if comm is None else comm["residuals"], step,
+            reduce_one,
+        )
+        new_comm = None
+        if comm is not None:
+            new_comm = {"residuals": new_residuals,
+                        "step": comm["step"] + 1}
+        return jax.tree_util.tree_unflatten(
+            treedef, plan.unpack(out_bufs, leaves)
+        ), new_comm
+
     def reduce(self, state: dict) -> tuple:
-        """One collective over everything accumulated; returns
-        ``(grads, fresh_state)`` — the mean over (world x count) when
-        ``gradient_average`` (over world only when
+        """One collective over everything accumulated (deferred mode) or
+        the flush of the final in-flight microbatch (overlap mode);
+        returns ``(grads, fresh_state)`` — the mean over (world x
+        count) when ``gradient_average`` (over world only when
         ``average_over_microbatches=False``, the reference scaling),
         the raw sum otherwise."""
+        if self.overlap_grad_sync:
+            return self._overlap_reduce(state)
         if self.gradient_average and self.average_over_microbatches:
             n = jnp.maximum(state["count"], 1).astype(jnp.float32)
             grads = jax.tree.map(lambda a: a / n, state["sum"])
@@ -629,3 +920,41 @@ class Reducer:
         else:
             grads = out
         return grads, fresh
+
+    def _overlap_reduce(self, state: dict) -> tuple:
+        comm = state.get("comm")
+        done = state["sum"]
+        if "pending" in state:
+            # the final microbatch's reduce — the one round with no
+            # following compute to hide behind (same as the reference
+            # DDP's trailing bucket)
+            reduced, comm = self._overlap_reduce_once(
+                state["pending"], comm
+            )
+            done = jax.tree.map(lambda a, r: a + r, done, reduced)
+        if isinstance(self.axis_name, (tuple, list)):
+            world = 1
+            for ax in self.axis_name:
+                world *= _axis_size(ax)
+        else:
+            world = _axis_size(self.axis_name)
+        # the exact scaling ops of the deferred path (sync()'s post
+        # divide, then the microbatch mean), so K=1 is bit-identical
+        if self.gradient_average:
+            post = world / self.gradient_predivide_factor
+            if post != 1.0:
+                done = jax.tree.map(lambda a: a / post, done)
+            if self.average_over_microbatches:
+                n = jnp.maximum(state["count"], 1).astype(jnp.float32)
+                done = jax.tree.map(lambda a: a / n, done)
+        elif self.gradient_predivide_factor != 1.0:
+            done = jax.tree.map(
+                lambda a: a * self.gradient_predivide_factor, done
+            )
+        fresh = {
+            "sum": jax.tree.map(jnp.zeros_like, state["sum"]),
+            "count": jnp.zeros((), jnp.int32),
+        }
+        if comm is not None:
+            fresh["comm"] = comm
+        return done, fresh

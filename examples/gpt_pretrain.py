@@ -117,6 +117,15 @@ def main(argv=None):
     ap.add_argument("--no-error-feedback", action="store_true",
                     help="drop the quantization-residual compensation "
                          "state (lossier; mainly for A/B experiments)")
+    ap.add_argument("--overlap-grad-sync", action="store_true",
+                    help="bucket the hierarchical gradient reduce "
+                         "(reverse-layer order) so the scheduler can "
+                         "overlap the per-bucket collectives with "
+                         "surrounding compute (requires --dp-ici-size; "
+                         "see docs/distributed.md)")
+    ap.add_argument("--bucket-mb", type=float, default=4.0,
+                    help="bucket size in MiB for --overlap-grad-sync "
+                         "(the reference's message_size analog)")
     ap.add_argument("--num-experts", type=int, default=None,
                     help="Switch-MoE experts riding dp as the ep axis")
     ap.add_argument("--position-embedding", default="learned",
@@ -140,6 +149,14 @@ def main(argv=None):
     if args.grad_compression != "none" and not hier:
         ap.error("--grad-compression quantizes the DCN leg of the "
                  "hierarchical reduce: it requires --dp-ici-size")
+    if args.overlap_grad_sync and not hier:
+        ap.error("--overlap-grad-sync buckets the hierarchical data "
+                 "sync: it requires --dp-ici-size")
+    if args.overlap_grad_sync and args.zero:
+        ap.error("--overlap-grad-sync applies to the DDP reduce; "
+                 "--zero replaces it with the sharded optimizer's "
+                 "reduce-scatter")
+    bucket_bytes = int(args.bucket_mb * 1024 * 1024)
     if hier and args.num_experts:
         ap.error("--dp-ici-size is incompatible with --num-experts "
                  "(experts ride the dp axis, which the hierarchical "
@@ -221,10 +238,24 @@ def main(argv=None):
             init_comm_state,
         )
 
-        comm_state = init_comm_state(params, data_axes, comp, mesh=mesh,
-                                 param_specs=specs)
-        comm_specs = comm_state_specs(comm_state, data_axes,
-                                      param_specs=specs)
+        if args.overlap_grad_sync:
+            # per-BUCKET residuals matching the bucketed reduce; the
+            # plan must see the same leaf shapes/dtypes and bucket
+            # size the in-step reduce derives its own plan from
+            from apex_tpu.parallel import GradientBuckets
+
+            plan = GradientBuckets.for_tree(
+                params, bucket_bytes, param_specs=specs, mesh=mesh)
+            comm_state = init_comm_state(
+                params, data_axes, comp, mesh=mesh, param_specs=specs,
+                buckets=plan)
+            comm_specs = comm_state_specs(comm_state, data_axes,
+                                          buckets=plan)
+        else:
+            comm_state = init_comm_state(
+                params, data_axes, comp, mesh=mesh, param_specs=specs)
+            comm_specs = comm_state_specs(comm_state, data_axes,
+                                          param_specs=specs)
     else:
         comm_state, comm_specs = {}, {}
 
@@ -301,7 +332,9 @@ def main(argv=None):
             if use_comm:
                 grads, new_comm = all_reduce_gradients(
                     grads, axis_name=data_axes, compression=comp,
-                    comm_state=comm_state)
+                    comm_state=comm_state,
+                    overlap_grad_sync=args.overlap_grad_sync,
+                    bucket_bytes=bucket_bytes)
                 if finite is not None:
                     # a skipped (overflowed) step must not absorb
                     # garbage into the residual
@@ -310,7 +343,9 @@ def main(argv=None):
                     new_comm = tree_where(finite, new_comm, comm_state)
             else:
                 grads = all_reduce_gradients(
-                    grads, axis_name=data_axes, compression=comp)
+                    grads, axis_name=data_axes, compression=comp,
+                    overlap_grad_sync=args.overlap_grad_sync,
+                    bucket_bytes=bucket_bytes)
         if args.clip_grad is not None:
             # AFTER unscale (clip sees true-magnitude grads), BEFORE the
             # optimizer; duplicate-aware over the mesh (tp/pp shards +

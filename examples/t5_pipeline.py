@@ -41,11 +41,20 @@ def main(argv=None):
                     help="int8-quantize the DCN leg of the hierarchical "
                          "gradient reduce (requires --dp-ici-size)")
     ap.add_argument("--no-error-feedback", action="store_true")
+    ap.add_argument("--overlap-grad-sync", action="store_true",
+                    help="bucket the hierarchical gradient reduce so "
+                         "the scheduler can overlap the per-bucket "
+                         "collectives (requires --dp-ici-size)")
+    ap.add_argument("--bucket-mb", type=float, default=4.0,
+                    help="bucket size in MiB for --overlap-grad-sync")
     args = ap.parse_args(argv)
 
     hier = args.dp_ici_size is not None
     if args.grad_compression != "none" and not hier:
         ap.error("--grad-compression requires --dp-ici-size")
+    if args.overlap_grad_sync and not hier:
+        ap.error("--overlap-grad-sync requires --dp-ici-size")
+    bucket_bytes = int(args.bucket_mb * 1024 * 1024)
     comp = None
     if args.grad_compression != "none":
         from apex_tpu.ops.quantization import CompressionConfig
@@ -92,6 +101,7 @@ def main(argv=None):
     opt_specs = state_specs_like(specs, opt_state)
 
     # error-feedback residual state for the compressed reduce
+    # (per-BUCKET residuals when the reduce is bucketed)
     use_comm = comp is not None and comp.error_feedback
     if use_comm:
         from apex_tpu.parallel.distributed import (
@@ -99,10 +109,21 @@ def main(argv=None):
             init_comm_state,
         )
 
-        comm_state = init_comm_state(params, data_axes, comp, mesh=mesh,
-                                 param_specs=specs)
-        comm_specs = comm_state_specs(comm_state, data_axes,
-                                      param_specs=specs)
+        if args.overlap_grad_sync:
+            from apex_tpu.parallel import GradientBuckets
+
+            plan = GradientBuckets.for_tree(
+                params, bucket_bytes, param_specs=specs, mesh=mesh)
+            comm_state = init_comm_state(
+                params, data_axes, comp, mesh=mesh, param_specs=specs,
+                buckets=plan)
+            comm_specs = comm_state_specs(comm_state, data_axes,
+                                          buckets=plan)
+        else:
+            comm_state = init_comm_state(
+                params, data_axes, comp, mesh=mesh, param_specs=specs)
+            comm_specs = comm_state_specs(comm_state, data_axes,
+                                          param_specs=specs)
     else:
         comm_state, comm_specs = {}, {}
 
@@ -125,10 +146,14 @@ def main(argv=None):
             if use_comm:
                 grads, comm = all_reduce_gradients(
                     grads, axis_name=data_axes, compression=comp,
-                    comm_state=comm)
+                    comm_state=comm,
+                    overlap_grad_sync=args.overlap_grad_sync,
+                    bucket_bytes=bucket_bytes)
             else:
                 grads = all_reduce_gradients(
-                    grads, axis_name=data_axes, compression=comp)
+                    grads, axis_name=data_axes, compression=comp,
+                    overlap_grad_sync=args.overlap_grad_sync,
+                    bucket_bytes=bucket_bytes)
         params, opt_state = opt.step(opt_state, grads, params)
         return params, opt_state, comm, loss
 

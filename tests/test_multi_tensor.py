@@ -60,3 +60,41 @@ def test_l2norm_per_tensor():
     total, per = multi_tensor_l2norm(tree, per_tensor=True)
     np.testing.assert_allclose([float(p) for p in per], [5.0, 13.0])
     np.testing.assert_allclose(float(total), np.sqrt(25 + 169))
+
+
+def test_scale_inf_from_scale_does_not_flag():
+    """The noop_flag contract checks INCOMING values (reference:
+    csrc/multi_tensor_scale_kernel.cu's per-element isfinite(r_in)):
+    finite inputs with an inf-producing scale must NOT raise it."""
+    tree = {"a": jnp.array([1.0, 2.0], jnp.float16)}
+    out, overflow = multi_tensor_scale(tree, jnp.float32(1e30))
+    assert not bool(overflow)
+    assert bool(jnp.isinf(out["a"]).any())  # the output DID overflow
+
+
+def test_scale_single_pass_checks_half_inputs():
+    """inf/nan arriving in half precision is caught on the one fp32
+    read the scaling itself uses (the cast is exact for half dtypes)."""
+    for bad in (jnp.inf, -jnp.inf, jnp.nan):
+        for dt in (jnp.float16, jnp.bfloat16):
+            tree = {"a": jnp.array([1.0, bad], dt),
+                    "b": jnp.ones((3,), jnp.float32),
+                    "n": jnp.arange(3)}  # int leaf: passed through
+            out, overflow = multi_tensor_scale(tree, 0.5)
+            assert bool(overflow), (bad, dt)
+            assert out["n"].dtype == tree["n"].dtype
+
+
+def test_axpby_inf_in_input_flags_either_side():
+    x = {"a": jnp.array([1.0, jnp.inf])}
+    y = {"a": jnp.array([1.0, 2.0])}
+    assert bool(multi_tensor_axpby(1.0, x, 1.0, y)[1])
+    assert bool(multi_tensor_axpby(1.0, y, 1.0, x)[1])
+
+
+def test_axpby_inf_from_coefficient_does_not_flag():
+    x = {"a": jnp.array([1.0, 2.0])}
+    y = {"a": jnp.array([3.0, 4.0])}
+    out, overflow = multi_tensor_axpby(jnp.float32(3e38), x, 1.0, y)
+    assert not bool(overflow)
+    assert bool(jnp.isinf(out["a"]).any())

@@ -1,5 +1,7 @@
 """Communication-bytes audit: compile a step, walk the HLO, and report
-per-collective bytes-on-wire split by mesh axis (dcn vs ici).
+per-collective bytes-on-wire split by mesh axis (dcn vs ici) — plus an
+OVERLAP audit of the *scheduled* HLO that proves gradient collectives
+have compute to hide behind (``--overlap``).
 
 Wall-clock DCN wins cannot be measured on the CI virtual mesh, so this
 tool proves the compressed-collectives win STRUCTURALLY: it compiles
@@ -24,10 +26,43 @@ spans more than one rank of that axis (a flat world-spanning psum
 therefore counts as crossing dcn — which is exactly the traffic the
 hierarchy exists to avoid).
 
+Overlap audit (``--overlap``): the bytes model above says nothing about
+whether the collective's LATENCY is exposed.  The optimized module is
+scheduled (``is_scheduled=true``), so the audit walks the instruction
+sequence and, per gradient collective:
+
+- counts literal ``-start``/``-done`` async pairs and the compute
+  scheduled inside each window (TPU/GPU backends emit these; the CPU
+  backend used on CI executes collectives synchronously and never
+  will — so zero pairs on CPU is expected, not a failure);
+- computes the SCHEDULABLE overlap from dataflow: every instruction
+  that is neither an ancestor of the collective's operands nor a
+  descendant of its result could legally execute between start and
+  done — that independent compute is exactly what a latency-hiding
+  scheduler needs, and its existence is provable on any backend;
+- estimates hidden vs exposed time under the ring wire model (bytes /
+  per-axis bandwidth vs a FLOP/byte model of the independent compute).
+  The estimate is optimistic — independent compute shared between two
+  collectives is counted for both — so read it as "could hide", and
+  the gate is on the overlappable FRACTION, not the milliseconds.
+
+The overlappable FRACTION reads 1.0 for both loops on this dataflow
+criterion (even the deferred reduce's late-layer collectives are
+independent of earlier layers' backward, and earlier microbatches'
+compute is dataflow-independent of the pipelined loop's final flush —
+whether a temporal schedule can exploit that is the estimate's
+optimism).  What separates the loops is the independent-compute
+VOLUME: with K microbatches the pipelined loop exposes roughly (K-1)
+extra whole microbatches of fwd/bwd per reduce round, so the gate
+pairs overlappable_frac (sanity: no collective is dataflow-locked)
+with overlap-vs-deferred ``independent_compute_ms`` (the pipelining
+actually created the windows).
+
 Run on the 8-device virtual mesh (no TPU needed):
 
     python tools/comm_audit.py                 # writes COMM_AUDIT.json
     python tools/comm_audit.py --ici-size 4 --block-size 256
+    python tools/comm_audit.py --overlap       # writes OVERLAP_AUDIT.json
 """
 
 from __future__ import annotations
@@ -161,10 +196,8 @@ def _wire_bytes(rec) -> float:
     return float(rec["operand_bytes"])  # collective-permute
 
 
-def classify_and_total(records, mesh, dcn_axis="dcn", ici_axis="ici"):
-    """Label each collective by the mesh axes its groups span and total
-    the wire bytes per label.  Device ids map to (dcn, ici) coordinates
-    through the mesh's device grid."""
+def _mesh_coords(mesh, dcn_axis="dcn", ici_axis="ici"):
+    """device id -> (dcn, ici) coordinate map for a mesh."""
     import numpy as np
 
     names = list(mesh.axis_names)
@@ -173,30 +206,39 @@ def classify_and_total(records, mesh, dcn_axis="dcn", ici_axis="ici"):
     grid = np.asarray(mesh.devices)
     for idx, dev in np.ndenumerate(grid):
         coords[dev.id] = (idx[di], idx[ii])
+    return coords
 
+
+def _axis_label(groups, pairs, coords):
+    """'dcn' | 'ici' | 'other' for a collective's replica groups."""
+    groups = groups or [list(p) for p in pairs]
+    crosses_dcn = crosses_ici = False
+    known = True
+    for grp in groups:
+        cs = [coords.get(d) for d in grp]
+        if any(c is None for c in cs):
+            known = False
+            break
+        crosses_dcn |= len({c[0] for c in cs}) > 1
+        crosses_ici |= len({c[1] for c in cs}) > 1
+    if not known or not groups:
+        return "other"
+    if crosses_dcn:
+        return "dcn"  # anything touching the slow axis bills dcn
+    if crosses_ici:
+        return "ici"
+    return "other"
+
+
+def classify_and_total(records, mesh, dcn_axis="dcn", ici_axis="ici"):
+    """Label each collective by the mesh axes its groups span and total
+    the wire bytes per label.  Device ids map to (dcn, ici) coordinates
+    through the mesh's device grid."""
+    coords = _mesh_coords(mesh, dcn_axis, ici_axis)
     totals = {"dcn": 0.0, "ici": 0.0, "other": 0.0}
     for rec in records:
-        groups = rec["replica_groups"] or [
-            list(p) for p in rec["pairs"]
-        ]
-        crosses_dcn = crosses_ici = False
-        known = True
-        for grp in groups:
-            cs = [coords.get(d) for d in grp]
-            if any(c is None for c in cs):
-                known = False
-                break
-            crosses_dcn |= len({c[0] for c in cs}) > 1
-            crosses_ici |= len({c[1] for c in cs}) > 1
+        label = _axis_label(rec["replica_groups"], rec["pairs"], coords)
         wb = _wire_bytes(rec)
-        if not known or not groups:
-            label = "other"
-        elif crosses_dcn:
-            label = "dcn"  # anything touching the slow axis bills dcn
-        elif crosses_ici:
-            label = "ici"
-        else:
-            label = "other"
         rec["axis"] = label
         rec["wire_bytes"] = wb
         totals[label] += wb
@@ -309,6 +351,378 @@ def run_audit(ici_size=4, block_size=256):
     }
 
 
+# ------------------------------------------------------------------ overlap
+#
+# Ring wire model extended with time: per-axis bandwidth for collective
+# duration, peak FLOP/s + HBM bandwidth for the compute that could hide
+# it.  v4-ish defaults; the gate uses fractions, not absolute ms.
+WIRE_MODEL = {
+    "flops": 275e12,      # peak bf16 FLOP/s per chip
+    "hbm_bytes_s": 1.2e12,
+    "dcn_bytes_s": 25e9,  # per-device DCN bandwidth
+    "ici_bytes_s": 90e9,  # per-device ICI bandwidth
+}
+
+# ops with no meaningful execution cost for the overlap estimate
+_FREE_OPS = {
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "after-all", "partition-id", "replica-id",
+}
+
+_COMP_HDR_RE = re.compile(
+    r"^(ENTRY\s+)?%?([\w\.\-]+)\s+\(.*\)\s*->\s*.*\{\s*$"
+)
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([\w\.\-]+)\s*=\s*((?:\([^=]*?\)|\S+))\s+"
+    r"([\w\-]+)\("
+)
+
+
+def _shape_elems(dtype: str, dims: str) -> int:
+    if dtype not in _DTYPE_BYTES:
+        return 0
+    n = 1
+    for d in dims.split(","):
+        if d.strip():
+            n *= int(d)
+    return n
+
+
+def _call_args(rest: str) -> str:
+    """The operand list of ``op(...)``: everything up to the paren that
+    closes the call (operand TYPES may nest parens for tuples)."""
+    depth = 1
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return rest[:i]
+    return rest
+
+
+def parse_instructions(hlo_text: str):
+    """Parse the (scheduled) HLO text into per-computation instruction
+    lists, each entry in program order with name, op, payload sizes,
+    operand names and — for collectives — replica groups."""
+    comps = {}
+    cur = None
+    for line in hlo_text.splitlines():
+        hm = _COMP_HDR_RE.match(line)
+        if hm:
+            cur = hm.group(2)
+            comps[cur] = []
+            continue
+        if cur is None:
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            if line.strip() == "}":
+                cur = None
+            continue
+        name, result, op = m.group(1), m.group(2), m.group(3)
+        rest = line[m.end():]
+        args = _call_args(rest)
+        operands = re.findall(r"(?<!=)%([\w\.\-]+)", args)
+        op_shapes = _SHAPE_RE.findall(args)
+        res_shapes = _SHAPE_RE.findall(result)
+        gm = _GROUPS_RE.search(line)
+        groups = []
+        if gm:
+            groups = [
+                [int(x) for x in g.split(",") if x.strip()]
+                for g in re.findall(r"\{([\d, ]*)\}", gm.group(1))
+            ]
+        pm = _PAIRS_RE.search(line)
+        pairs = []
+        if pm:
+            pairs = [
+                tuple(int(x) for x in p.split(","))
+                for p in re.findall(r"\{([\d, ]+)\}", pm.group(1))
+            ]
+        comps[cur].append({
+            "name": name,
+            "op": op,
+            "operands": operands,
+            "result_bytes": sum(_shape_bytes(d, s) for d, s in res_shapes),
+            "result_elems": sum(_shape_elems(d, s) for d, s in res_shapes),
+            "operand_bytes": sum(_shape_bytes(d, s) for d, s in op_shapes),
+            "operand_elems": [_shape_elems(d, s) for d, s in op_shapes],
+            "replica_groups": groups,
+            "pairs": pairs,
+        })
+    return comps
+
+
+def _base_collective(op: str):
+    for c in _COLLECTIVES:
+        if op == c or op == c + "-start" or op == c + "-done":
+            return c
+    return None
+
+
+def _compute_time_s(rec, model=WIRE_MODEL) -> float:
+    """Rough execution-time estimate for one (non-collective)
+    instruction: dots by a FLOP model (contracted extent inferred from
+    the element counts), everything else memory-bound."""
+    op = rec["op"]
+    if op in _FREE_OPS or _base_collective(op):
+        return 0.0
+    if op in ("dot", "convolution"):
+        res = max(rec["result_elems"], 1)
+        ops = rec["operand_elems"]
+        if len(ops) >= 2 and ops[0] and ops[1]:
+            k = (ops[0] * ops[1] / res) ** 0.5
+        else:
+            k = 1.0
+        return 2.0 * res * max(k, 1.0) / model["flops"]
+    return (rec["result_bytes"] + rec["operand_bytes"]) \
+        / model["hbm_bytes_s"]
+
+
+def _collective_time_s(rec, label, model=WIRE_MODEL) -> float:
+    wb = _wire_bytes(rec)
+    bw = model["dcn_bytes_s"] if label == "dcn" else model["ici_bytes_s"]
+    return wb / bw
+
+
+def analyze_overlap(hlo_text: str, mesh=None, dcn_axis="dcn",
+                    ici_axis="ici", model=WIRE_MODEL):
+    """Walk every computation of a SCHEDULED module and, for each
+    collective, measure what a latency-hiding scheduler can put between
+    its start and done:
+
+    - async ``-start``/``-done`` pairs: the compute actually scheduled
+      inside the window (the backend already committed to the overlap);
+    - synchronous collectives: the compute that is dataflow-INDEPENDENT
+      of the collective (neither ancestor nor descendant) — legal to
+      schedule inside the window, i.e. the structural overlap a
+      latency-hiding backend can exploit.
+
+    Returns ``(per_collective_records, summary)``."""
+    comps = parse_instructions(hlo_text)
+    coords = _mesh_coords(mesh, dcn_axis, ici_axis) if mesh else None
+    out = []
+    for cname, instrs in comps.items():
+        index = {r["name"]: i for i, r in enumerate(instrs)}
+        deps = [
+            [index[o] for o in r["operands"] if o in index]
+            for r in instrs
+        ]
+        users = [[] for _ in instrs]
+        for i, ds in enumerate(deps):
+            for d in ds:
+                users[d].append(i)
+
+        def closure(start_idx, edges):
+            seen = set()
+            todo = list(edges[start_idx])
+            while todo:
+                j = todo.pop()
+                if j in seen:
+                    continue
+                seen.add(j)
+                todo.extend(edges[j])
+            return seen
+
+        for i, r in enumerate(instrs):
+            base = _base_collective(r["op"])
+            if base is None or r["op"].endswith("-done"):
+                continue
+            is_start = r["op"].endswith("-start")
+            rec = {
+                "computation": cname,
+                "op": base,
+                "name": r["name"],
+                "async_pair": False,
+                "result_bytes": r["result_bytes"],
+                "operand_bytes": r["operand_bytes"],
+                "replica_groups": r["replica_groups"],
+                "pairs": r["pairs"],
+            }
+            if is_start:
+                done = next(
+                    (j for j in range(i + 1, len(instrs))
+                     if instrs[j]["op"] == base + "-done"
+                     and r["name"] in instrs[j]["operands"]),
+                    None,
+                )
+                rec["async_pair"] = done is not None
+                window = instrs[i + 1:done] if done is not None else []
+                hidden = sum(_compute_time_s(w, model) for w in window)
+            else:
+                anc = closure(i, deps)
+                desc = closure(i, users)
+                excluded = anc | desc | {i}
+                hidden = sum(
+                    _compute_time_s(w, model)
+                    for j, w in enumerate(instrs)
+                    if j not in excluded
+                )
+            label = (_axis_label(r["replica_groups"], r["pairs"], coords)
+                     if coords else "other")
+            t = _collective_time_s(rec, label, model)
+            rec.update({
+                "axis": label,
+                "wire_bytes": round(_wire_bytes(rec), 1),
+                "collective_s": t,
+                "hidden_s": min(hidden, t),
+                "independent_compute_s": hidden,
+                "exposed_s": max(0.0, t - hidden),
+                "overlappable": hidden > 0.0,
+            })
+            out.append(rec)
+    coll = sum(r["collective_s"] for r in out)
+    hidden = sum(r["hidden_s"] for r in out)
+    exposed = sum(r["exposed_s"] for r in out)
+    indep = sum(r["independent_compute_s"] for r in out)
+    n = len(out)
+    summary = {
+        "n_collectives": n,
+        "n_async_pairs": sum(1 for r in out if r["async_pair"]),
+        "n_overlappable": sum(1 for r in out if r["overlappable"]),
+        "overlappable_frac": round(
+            sum(1 for r in out if r["overlappable"]) / n, 3
+        ) if n else 0.0,
+        "collective_ms": round(coll * 1e3, 4),
+        "hidden_ms": round(hidden * 1e3, 4),
+        "exposed_ms": round(exposed * 1e3, 4),
+        "hidden_frac": round(hidden / coll, 3) if coll else 0.0,
+        # how much compute each collective could hide behind, on
+        # average — the number that separates the pipelined loop
+        # (whole microbatches of independent fwd/bwd per round) from
+        # the deferred one (only the last backward's tail)
+        "independent_compute_ms": round(indep * 1e3, 4),
+        "mean_independent_compute_ms_per_collective": round(
+            indep / n * 1e3, 5
+        ) if n else 0.0,
+    }
+    for ax in ("dcn", "ici"):
+        rs = [r for r in out if r["axis"] == ax]
+        summary[f"{ax}_collectives"] = len(rs)
+        summary[f"{ax}_overlappable"] = sum(
+            1 for r in rs if r["overlappable"]
+        )
+    return out, summary
+
+
+# MLP proxy for the audited accumulation loop: per-layer leaves so the
+# reverse-order bucket assembly has real structure, matmul fwd/bwd so
+# the "independent compute" the analysis finds is genuine dot work
+_OVERLAP_LAYERS = 4
+_OVERLAP_WIDTH = 128
+
+
+def _overlap_params(key=0):
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(jax.random.PRNGKey(key),
+                          2 * _OVERLAP_LAYERS + 1)
+    p = {}
+    for l in range(_OVERLAP_LAYERS):
+        p[f"l{l}"] = {
+            "w": 0.1 * jax.random.normal(
+                ks[2 * l], (_OVERLAP_WIDTH, _OVERLAP_WIDTH)),
+            "b": jnp.zeros((_OVERLAP_WIDTH,)),
+        }
+    p["head"] = 0.1 * jax.random.normal(
+        ks[-1], (_OVERLAP_WIDTH, 2 * _OVERLAP_WIDTH))
+    return p
+
+
+def _overlap_loss(p, x):
+    import jax.numpy as jnp
+
+    h = x
+    for l in range(_OVERLAP_LAYERS):
+        h = jnp.tanh(h @ p[f"l{l}"]["w"] + p[f"l{l}"]["b"])
+    z = h @ p["head"]
+    return jnp.sum(z * z) / z.size
+
+
+def compile_grad_sync_loop(overlap, compression=None, ici_size=4,
+                           bucket_bytes=96 * 1024, num_micro=3,
+                           rows=16):
+    """Compile the K-microbatch accumulate-and-reduce loop (pipelined
+    when ``overlap``, the deferred seed pattern otherwise) and return
+    ``(scheduled_hlo_text, mesh)``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel import hierarchical_data_parallel_mesh
+    from apex_tpu.parallel.distributed import Reducer
+
+    mesh = hierarchical_data_parallel_mesh(ici_size=ici_size)
+    shard_map = _shard_map()
+    params = _overlap_params()
+    red = Reducer(
+        axis_name=("dcn", "ici"), overlap_grad_sync=overlap,
+        bucket_bytes=bucket_bytes, compression=compression,
+    )
+
+    def step(p, batch):
+        acc = red.init(p)
+        for k in range(num_micro):
+            g = jax.grad(_overlap_loss)(p, batch[k])
+            acc = red.accumulate(acc, g)
+        grads, _ = red.reduce(acc)
+        return grads
+
+    pspec = jax.tree.map(lambda _: P(), params)
+    data = jnp.zeros(
+        (num_micro, rows * mesh.devices.size, _OVERLAP_WIDTH)
+    )
+    fn = jax.jit(shard_map(
+        step, mesh, (pspec, P(None, ("dcn", "ici"))), pspec,
+    ))
+    txt = fn.lower(params, data).compile().as_text()
+    return txt, mesh
+
+
+def run_overlap_audit(ici_size=4, bucket_kb=96, num_micro=3):
+    """Overlapped vs deferred grad sync through the scheduled-HLO
+    analysis, plus the int8-compressed overlapped variant.  The
+    headline value is the overlapped loop's overlappable fraction
+    (sanity gate: every grad collective has SOME independent compute);
+    the discriminating number is independent_compute_ms overlap vs
+    deferred — pipelining adds ~(K-1) microbatches of hideable
+    compute per round (see the module docstring)."""
+    results = {}
+    for tag, overlap, comp in (
+        ("overlap", True, None),
+        ("deferred", False, None),
+        ("overlap_int8", True, "int8"),
+    ):
+        txt, mesh = compile_grad_sync_loop(
+            overlap, comp, ici_size=ici_size,
+            bucket_bytes=bucket_kb * 1024, num_micro=num_micro,
+        )
+        records, summary = analyze_overlap(txt, mesh)
+        results[tag] = {
+            "summary": summary,
+            "collectives": [
+                {k: rec[k] for k in (
+                    "op", "axis", "wire_bytes", "overlappable",
+                    "async_pair")}
+                for rec in records
+            ],
+        }
+    return {
+        "metric": "grad_sync_overlappable_fraction",
+        "value": results["overlap"]["summary"]["overlappable_frac"],
+        "unit": "fraction of grad collectives with independent compute "
+                "to hide behind (pipelined loop)",
+        "num_micro": num_micro,
+        "bucket_kb": bucket_kb,
+        "ici_size": ici_size,
+        "wire_model": WIRE_MODEL,
+        **results,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ici-size", type=int, default=4)
@@ -318,13 +732,43 @@ def main():
     ap.add_argument("--min-ratio", type=float, default=None,
                     help="exit nonzero unless the dcn-bytes ratio "
                          "meets this floor")
-    ap.add_argument("--out", default=os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "COMM_AUDIT.json",
-    ))
+    ap.add_argument("--overlap", action="store_true",
+                    help="audit the scheduled HLO of the pipelined "
+                         "accumulate-and-reduce loop instead of the "
+                         "bytes A/B (writes OVERLAP_AUDIT.json)")
+    ap.add_argument("--num-micro", type=int, default=3)
+    ap.add_argument("--bucket-kb", type=int, default=96)
+    ap.add_argument("--min-overlappable", type=float, default=None,
+                    help="with --overlap: exit nonzero unless the "
+                         "overlappable fraction meets this floor")
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
     _force_virtual_devices(args.devices)
 
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.overlap:
+        out_path = args.out or os.path.join(root, "OVERLAP_AUDIT.json")
+        doc = run_overlap_audit(args.ici_size, args.bucket_kb,
+                                args.num_micro)
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({
+            "metric": doc["metric"], "value": doc["value"],
+            "unit": doc["unit"],
+            "overlap": doc["overlap"]["summary"],
+            "deferred": doc["deferred"]["summary"],
+            "overlap_int8": doc["overlap_int8"]["summary"],
+        }))
+        print(f"wrote {out_path}")
+        if (args.min_overlappable is not None
+                and doc["value"] < args.min_overlappable):
+            raise SystemExit(
+                f"overlappable fraction {doc['value']} < floor "
+                f"{args.min_overlappable}"
+            )
+        return
+
+    args.out = args.out or os.path.join(root, "COMM_AUDIT.json")
     doc = run_audit(args.ici_size, args.block_size)
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=1)
