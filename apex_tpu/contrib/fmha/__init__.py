@@ -14,15 +14,17 @@ production stacks express varlen attention.  The segment masking happens
 reference's seqlen<=512 window this path has no length limit and never
 materialises the (s, s) score matrix.
 
-Short-sequence dispatch: the reference's whole reason for its
+Seqlen-specialized dispatch: the reference's whole reason for its
 {128,256,384,512} per-seqlen kernels is that short sequences want a
 different schedule.  This wrapper now gets the same specialization for
-free — ``flash_attention(implementation=None)`` auto-routes to the
-single-pass fmha-short kernel (``ops/attention_short.py``) whenever
-``max_seq_len`` is at or below the measured crossover, so a packed
-batch in the reference's own seqlen window runs the short schedule
-while longer batches keep the online-softmax flash kernel.  Pass
-``implementation="short"`` (or ``"pallas"``/``"xla"``) to force a path.
+free — ``flash_attention(implementation=None)`` walks the measured
+three-tier ladder (``docs/attention.md``): a packed batch in the
+reference's own seqlen window runs the single-pass fmha-short kernel
+(``ops/attention_short.py``), the 512 < s <= ~2048 band runs the
+pipelined fmha-mid kernel (``ops/attention_mid.py`` — streamed
+k-blocks, batch*head packing, causal block-skip), and longer batches
+keep the online-softmax flash kernel.  Pass ``implementation="short"``
+/ ``"mid"`` (or ``"pallas"``/``"xla"``) to force a path.
 """
 
 from __future__ import annotations
@@ -83,9 +85,10 @@ def fmha(
 class FMHA:
     """Module wrapper (reference: apex/contrib/fmha/fmha.py ``FMHA``).
 
-    ``implementation=None`` (default) keeps the measured auto-dispatch
-    (short kernel at or below the crossover, flash above); ``"short"``
-    / ``"pallas"`` / ``"xla"`` force a path.
+    ``implementation=None`` (default) keeps the measured dispatch
+    ladder (short kernel at or below the short crossover, pipelined
+    mid kernel through the mid crossover, flash above); ``"short"`` /
+    ``"mid"`` / ``"pallas"`` / ``"xla"`` force a path.
     """
 
     def __init__(self, causal: bool = False,

@@ -43,10 +43,12 @@ def _attend(q, k, v, scale, mask_bias, causal, impl,
     fuses it into its CUDA kernels via Philox; here the flash kernel's
     counter-based hash plays that role, and the 'default' XLA path draws
     the identical mask).  ``attention_impl`` is forwarded to
-    ``flash_attention`` on the 'fast' path (None = measured
-    auto-dispatch, which routes short sequences — the reference MHA
-    extensions' own seqlen regime — to the single-pass fmha-short
-    kernel)."""
+    ``flash_attention`` on the 'fast' path (None = the measured
+    three-tier dispatch ladder: short sequences — the reference MHA
+    extensions' own seqlen regime — run the single-pass fmha-short
+    kernel, the 512 < s <= ~2048 band runs the pipelined fmha-mid
+    kernel, longer sequences the streamed flash kernel;
+    "short"/"mid"/"pallas"/"xla" force one — docs/attention.md)."""
     q_seg = kv_seg = None
     if kv_pad_mask is not None:
         # segment ids keep padding exclusion inside the flash kernel
@@ -100,9 +102,10 @@ class _MHABase:
         self.use_bias = bias
         self.include_norm_add = include_norm_add
         self.impl = impl
-        # kernel choice for impl='fast': None = measured auto-dispatch
-        # (short kernel in the reference extensions' seqlen regime),
-        # "short"/"pallas"/"xla" force one
+        # kernel choice for impl='fast': None = the measured dispatch
+        # ladder (short kernel in the reference extensions' seqlen
+        # regime, pipelined mid kernel through ~2048, flash above),
+        # "short"/"mid"/"pallas"/"xla" force one
         self.attention_impl = attention_impl
         self.params_dtype = params_dtype
         self.norm_dtype = norm_dtype

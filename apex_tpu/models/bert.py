@@ -56,8 +56,10 @@ class BertConfig:
     fused_ce: Optional[bool] = None
     fused_ce_chunk: int = 8192
     add_binary_head: bool = True
-    # "short" | "pallas" | "xla" | None = auto (measured windows: BERT's
-    # typical s<=512 encoder runs the single-pass fmha-short kernel)
+    # "short" | "mid" | "pallas" | "xla" | None = auto via the measured
+    # dispatch ladder (docs/attention.md): BERT's typical s<=512
+    # encoder runs the single-pass fmha-short kernel; longer-context
+    # fine-tunes land in the pipelined fmha-mid window
     attention_impl: Optional[str] = None
 
     def __post_init__(self):

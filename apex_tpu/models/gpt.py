@@ -116,9 +116,12 @@ class GPTConfig:
     # scan that never materializes logits.  True/False forces a path.
     fused_ce: Optional[bool] = None
     fused_ce_chunk: int = 8192
-    # None → platform + measured dispatch windows (short sequences run
-    # the single-pass fmha-short kernel, ops/attention_short.py);
-    # "short"/"pallas"/"xla" force one attention kernel everywhere
+    # None → platform + the measured three-tier dispatch ladder
+    # (short sequences run the single-pass fmha-short kernel, the
+    # 512 < s <= ~2048 band — the flagship shape — runs the pipelined
+    # fmha-mid kernel, longer sequences the streamed flash kernel;
+    # docs/attention.md); "short"/"mid"/"pallas"/"xla" force one
+    # attention kernel everywhere
     attention_impl: Optional[str] = None
     # shard the sequence dim over the "cp" mesh axis and use ring
     # attention — long-context training (new capability vs the reference,
@@ -414,7 +417,19 @@ class GPTModel:
         elif c.context_parallel:
             from apex_tpu.ops.ring_attention import ring_attention
 
-            attn = ring_attention(q, k, v, causal=True)
+            # config attention_impl threads into the per-shard inner
+            # attention.  "xla" maps to None: the inline ring walk IS
+            # the XLA implementation here, and unlike the lse-merge
+            # formulation it keeps the documented (s_local, block_k)
+            # score bound (the merge's "xla" mode materializes
+            # (s_local, s_local) per ring step — an A/B reference, not
+            # a production path)
+            attn = ring_attention(
+                q, k, v, causal=True,
+                attention_impl=(
+                    None if c.attention_impl == "xla" else c.attention_impl
+                ),
+            )
         else:
             attn = flash_attention(
                 q, k, v, causal=True, implementation=c.attention_impl

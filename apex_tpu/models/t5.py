@@ -80,10 +80,13 @@ class T5Config:
     remat_policy: Optional[str] = "dots_with_no_batch_dims_saveable"
     fused_ce: Optional[bool] = None
     fused_ce_chunk: int = 8192
-    # "short" | "pallas" | "xla" | None = auto — the short-decoder /
+    # "short" | "mid" | "pallas" | "xla" | None = auto via the measured
+    # dispatch ladder (docs/attention.md) — the short-decoder /
     # short-encoder shapes T5 trains at sit inside the fmha-short
     # dispatch window (ops/attention_short.py), including both
-    # self-attention and the sq!=sk cross-attention calls below
+    # self-attention and the sq!=sk cross-attention calls below;
+    # longer contexts route to the pipelined fmha-mid kernel (the
+    # ladder keys on max(sq, sk) for cross-attention)
     attention_impl: Optional[str] = None
     # route the pipeline path through pipeline_encdec_fused: ONE
     # homogeneous stage body per tick (gated cross-attention +

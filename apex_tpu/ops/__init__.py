@@ -26,6 +26,9 @@ from apex_tpu.ops.attention import (  # noqa: F401
 from apex_tpu.ops.attention_short import (  # noqa: F401
     fmha_short,
 )
+from apex_tpu.ops.attention_mid import (  # noqa: F401
+    fmha_mid,
+)
 from apex_tpu.ops.quantization import (  # noqa: F401
     CompressionConfig,
     dequantize_blockwise,
@@ -38,6 +41,7 @@ __all__ = [
     "dequantize_blockwise",
     "quantize_blockwise",
     "quantized_psum",
+    "fmha_mid",
     "fmha_short",
     "fused_layer_norm",
     "fused_layer_norm_affine",

@@ -819,14 +819,20 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Flash attention over ``(batch, heads, seq, head_dim)``.
 
-    ``implementation`` is ``"pallas"`` (TPU kernel), ``"xla"``
-    (reference path, also the CPU fallback), or ``"short"`` (the
+    ``implementation`` is ``"pallas"`` (the streamed flash kernel),
+    ``"xla"`` (reference path, also the CPU fallback), ``"short"`` (the
     single-pass short-sequence kernel family in
     ``ops/attention_short.py`` — the analog of the reference's
-    per-seqlen {128,256,384,512} fmha kernels); default picks by
-    platform and the measured dispatch windows.  ``block_q``/``block_k``
-    only apply to the flash kernel (the short kernel holds the whole
-    sequence and blocks the batch*heads dimension instead).
+    per-seqlen {128,256,384,512} fmha kernels), or ``"mid"`` (the
+    pipelined mid-sequence kernel in ``ops/attention_mid.py``: smaller
+    streamed k-blocks + batch*head packing + causal block-skipping for
+    the 512 < s <= ~2048 band); default picks by platform and the
+    measured three-tier dispatch ladder short → mid → flash
+    (crossovers ``FMHA_SHORT_MAX_SEQ`` / ``FMHA_MID_MAX_SEQ``,
+    env-overridable — see ``docs/attention.md``).
+    ``block_q``/``block_k`` only apply to the flash kernel (the short
+    kernel holds the whole sequence and blocks the batch*heads
+    dimension instead; the mid kernel sizes its own blocks).
 
     ``bias`` is an additive score bias broadcastable from
     ``(1|b, 1|h, sq, sk)``; it is differentiable by default (the backward
@@ -856,7 +862,7 @@ def flash_attention(
         bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
     from apex_tpu.ops.common import KernelLoweringError, run_kernel
 
-    if pl is None and implementation in ("pallas", "short"):
+    if pl is None and implementation in ("pallas", "short", "mid"):
         raise KernelLoweringError(
             f"implementation={implementation!r} requested but Pallas "
             "failed to import"
@@ -873,8 +879,21 @@ def flash_attention(
             implementation="pallas" if forced else None,
         )
 
+    def _mid_path(forced: bool):
+        from apex_tpu.ops.attention_mid import fmha_mid
+
+        return fmha_mid(
+            q, k, v, causal=causal, sm_scale=sm_scale, bias=bias,
+            q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+            bias_requires_grad=bias_requires_grad,
+            implementation="pallas" if forced else None,
+        )
+
     if implementation == "short":
         return _short_path(forced=True)
+    if implementation == "mid":
+        return _mid_path(forced=True)
     impl = implementation or default_implementation()
     if (
         implementation is None
@@ -903,6 +922,21 @@ def flash_attention(
             # window above fires first, so fp32 short sequences keep
             # their measured XLA routing until a capture says otherwise.
             return _short_path(forced=False)
+        from apex_tpu.ops.attention_mid import mid_seq_threshold
+
+        mthr = mid_seq_threshold()
+        if max(q.shape[2], k.shape[2]) <= mthr:
+            # mid-sequence window (short crossover < s <= mid
+            # crossover): the flash kernel's measured-optimal
+            # 1024x1024 blocks degenerate to <= 2 k-blocks here — no
+            # software pipelining, no causal block-skip (PROFILE_r05:
+            # 10.2 TF/s at s=1024 causal vs ~50 at s>=4096) — so the
+            # pipelined mid kernel streams smaller k-blocks with
+            # batch*head packing instead (crossover constant
+            # FMHA_MID_MAX_SEQ, recorded/gated by kernel_validation;
+            # APEX_TPU_FMHA_MID_MAX_SEQ=0 pins this window back to
+            # the flash kernel bit-identically)
+            return _mid_path(forced=False)
     if pl is None:
         impl = "xla"
 

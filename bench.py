@@ -52,6 +52,23 @@ TOTAL_BUDGET = int(os.environ.get("APEX_BENCH_TOTAL_BUDGET", "3000"))
 # wastes the whole gate — instead probe with backoff until only the
 # reserve is left.
 MEASURE_RESERVE = int(os.environ.get("APEX_BENCH_MEASURE_RESERVE", "1500"))
+# The probe LOOP's own wall cap, separate from the per-attempt window:
+# BENCH_r05 burned ~1500 s (everything down to the reserve) probing an
+# unreachable TPU before the CPU fallback even started.  At least one
+# attempt always runs unless the budget is 0 (= skip probing entirely).
+PROBE_BUDGET = int(os.environ.get("APEX_TPU_BENCH_PROBE_BUDGET", "600"))
+# How long a cached probe failure from the SAME BOOT suppresses the
+# probe (BENCH_WATCH.json "probe_failure" record): a wedged chip claim
+# does not heal in minutes, so back-to-back gate runs should not each
+# re-pay the probe budget.  0 disables the cache check (tpu_watch sets
+# this for its post-contact full-bench run, where the chip is known
+# reachable).
+PROBE_CACHE_S = int(os.environ.get("APEX_TPU_BENCH_PROBE_CACHE_S", "10800"))
+# Persisted by tools/tpu_watch.py on capture; this bench also parks its
+# probe-failure cache here (merged, so a capture record is never lost)
+BENCH_WATCH_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_WATCH.json"
+)
 # Persisted record of the last successful TPU-captured bench, so a
 # flaky tunnel at gate time cannot erase hardware evidence: the CPU
 # fallback output carries this forward as `last_tpu_result`.
@@ -104,6 +121,63 @@ def _load_last_tpu():
             return json.load(f)
     except Exception:
         return None
+
+
+def _boot_id():
+    """Kernel boot id — the cache key that makes a probe-failure record
+    die with the machine: a reboot resets the axon claim state, so a
+    pre-reboot failure must not suppress post-reboot probes."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def _load_watch():
+    try:
+        with open(BENCH_WATCH_PATH) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except Exception:
+        return {}
+
+
+def _cached_probe_failure():
+    """A same-boot, recent probe failure record (or None)."""
+    if PROBE_CACHE_S <= 0:
+        return None
+    rec = _load_watch().get("probe_failure")
+    if not isinstance(rec, dict):
+        return None
+    boot = _boot_id()
+    if boot is None or rec.get("boot_id") != boot:
+        return None
+    age = time.time() - rec.get("at", 0)
+    if not (0 <= age <= PROBE_CACHE_S):
+        return None
+    return rec
+
+
+def _set_probe_failure(rec):
+    """Merge (rec != None) or clear (rec == None) the probe-failure
+    cache without disturbing tpu_watch's capture record."""
+    watch = _load_watch()
+    if rec is None and "probe_failure" not in watch:
+        return
+    if rec is None:
+        watch.pop("probe_failure", None)
+    else:
+        watch["probe_failure"] = rec
+    # tmp + rename: this file also holds tpu_watch's captured hardware
+    # evidence, which a SIGTERM mid-rewrite must not be able to destroy
+    tmp = BENCH_WATCH_PATH + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(watch, f, indent=1)
+        os.replace(tmp, BENCH_WATCH_PATH)
+    except OSError as e:
+        log(f"probe-failure cache write failed: {e}")
 
 
 # --------------------------------------------------------------------- child
@@ -1012,41 +1086,78 @@ def main():
     def budget_left():
         return TOTAL_BUDGET - (time.perf_counter() - t_start)
 
-    # Probe with exponential backoff until only the measurement reserve
-    # is left.  The axon chip-claim wedge outlives any fixed small retry
-    # count; a single late success is worth far more than extras, so the
-    # probe window is everything the measurement doesn't need.
+    # Probe with exponential backoff until the probe budget OR the
+    # measurement reserve runs out, whichever comes first.  The r5
+    # lesson cuts both ways: the axon chip-claim wedge outlives any
+    # fixed small retry count (so backoff, not N retries) — but probing
+    # all the way down to the reserve burned 1500 s of the r05 gate
+    # before the CPU fallback started, so the loop now has its own cap
+    # (APEX_TPU_BENCH_PROBE_BUDGET) and a same-boot failure cache that
+    # skips the probe entirely when a recent run already paid for the
+    # same answer.
     platform = None
     backoff = 20
     attempt = 0
     # the reserve can never eat the whole budget: at least one probe
     # attempt always runs (a small-budget env var combo must not turn
-    # the gate into a silent CPU bench)
+    # the gate into a silent CPU bench) — unless probing is skipped
+    # outright by budget 0 or the failure cache
     reserve = min(MEASURE_RESERVE, max(0, TOTAL_BUDGET - PROBE_TIMEOUT - 60))
-    while attempt == 0 or budget_left() > reserve:
-        ok, probe, err = _run_child(
-            ["--child", "probe"],
-            min(PROBE_TIMEOUT, max(30, budget_left() - reserve)),
-        )
-        if ok:
-            platform = probe["platform"]
-            log(f"probe: {probe}")
-            break
-        tail = err.strip().splitlines()[-1] if err.strip() else err
-        errors.append(f"probe[{attempt}]: {tail}")
-        log(f"probe attempt {attempt} failed: {err[-300:]}")
-        attempt += 1
-        sleep_for = min(backoff, max(0, budget_left() - reserve))
-        if sleep_for <= 0:
-            break
-        log(f"probe backoff: sleeping {sleep_for:.0f}s "
-            f"({budget_left():.0f}s budget left)")
-        time.sleep(sleep_for)
-        backoff = min(backoff * 2, 600)
-    if platform is None:
+    cached = _cached_probe_failure()
+    if PROBE_BUDGET <= 0:
+        errors.append("probe skipped: APEX_TPU_BENCH_PROBE_BUDGET <= 0")
+        log(errors[-1])
+    elif cached is not None:
+        errors.append(
+            "probe skipped: same-boot failure cached in BENCH_WATCH.json "
+            f"({time.time() - cached.get('at', 0):.0f}s ago, "
+            f"{cached.get('attempts', '?')} attempts); set "
+            "APEX_TPU_BENCH_PROBE_CACHE_S=0 to force a probe")
+        log(errors[-1])
+    else:
+        probe_t0 = time.perf_counter()
+
+        def probe_left():
+            return PROBE_BUDGET - (time.perf_counter() - probe_t0)
+
+        while attempt == 0 or (budget_left() > reserve
+                               and probe_left() > 0):
+            ok, probe, err = _run_child(
+                ["--child", "probe"],
+                min(PROBE_TIMEOUT, max(30, budget_left() - reserve),
+                    max(30, probe_left())),
+            )
+            if ok:
+                platform = probe["platform"]
+                log(f"probe: {probe}")
+                break
+            tail = err.strip().splitlines()[-1] if err.strip() else err
+            errors.append(f"probe[{attempt}]: {tail}")
+            log(f"probe attempt {attempt} failed: {err[-300:]}")
+            attempt += 1
+            sleep_for = min(backoff, max(0, budget_left() - reserve),
+                            max(0, probe_left()))
+            if sleep_for <= 0:
+                break
+            log(f"probe backoff: sleeping {sleep_for:.0f}s "
+                f"({budget_left():.0f}s budget, "
+                f"{probe_left():.0f}s probe budget left)")
+            time.sleep(sleep_for)
+            backoff = min(backoff * 2, 600)
+    if platform is None and attempt > 0:
+        # real gave-up (not a deliberate skip, which already logged its
+        # own reason above): record it so the next same-boot run skips
         errors.append(
             f"probe gave up after {attempt} attempts / "
-            f"{time.perf_counter() - t_start:.0f}s (reserve {reserve}s)")
+            f"{time.perf_counter() - t_start:.0f}s "
+            f"(reserve {reserve}s, probe budget {PROBE_BUDGET}s)")
+        boot = _boot_id()
+        if boot is not None:
+            _set_probe_failure({"boot_id": boot, "at": time.time(),
+                                "attempts": attempt})
+    elif platform is not None and platform != "cpu":
+        # chip contact invalidates any cached failure immediately
+        _set_probe_failure(None)
 
     result = None
     on_tpu = False

@@ -275,13 +275,17 @@ class TestShortDispatch:
                 return jnp.zeros(q.shape, q.dtype)
             return f
 
+        from apex_tpu.ops import attention_mid as mid_mod
+
         monkeypatch.setattr(attn_mod, "_flash_attention_pallas",
                             fake("flash"))
         monkeypatch.setattr(short_mod, "_fmha_short_pallas", fake("short"))
+        monkeypatch.setattr(mid_mod, "_fmha_mid_pallas", fake("mid"))
         monkeypatch.setattr(plat, "_current_platform", lambda: "tpu")
         monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
         monkeypatch.delenv("APEX_TPU_STRICT_KERNELS", raising=False)
         monkeypatch.delenv("APEX_TPU_FMHA_SHORT_MAX_SEQ", raising=False)
+        monkeypatch.delenv("APEX_TPU_FMHA_MID_MAX_SEQ", raising=False)
         return calls
 
     def test_bf16_below_crossover_picks_short(self, monkeypatch):
@@ -297,20 +301,23 @@ class TestShortDispatch:
         flash_attention(q, q, q)
         assert calls == ["short"]
 
-    def test_bf16_above_crossover_picks_flash(self, monkeypatch):
+    def test_bf16_above_crossover_leaves_short(self, monkeypatch):
+        # just above the short window the ladder's NEXT tier (the
+        # pipelined mid kernel) takes over — never short
         calls = self._spy(monkeypatch)
         q = jnp.ones((1, 1, FMHA_SHORT_MAX_SEQ + 128, 64), jnp.bfloat16)
         flash_attention(q, q, q)
-        assert calls == ["flash"]
+        assert calls == ["mid"]
 
     def test_long_kv_disqualifies_short(self, monkeypatch):
         # cross-attention with short q but long kv: the whole-kv-in-one-
-        # block premise fails, so the flash kernel must run
+        # block premise fails, so a streaming tier must run (the mid
+        # kernel here — kv sits at its window edge)
         calls = self._spy(monkeypatch)
         q = jnp.ones((1, 1, 256, 64), jnp.bfloat16)
         kv = jnp.ones((1, 1, 2048, 64), jnp.bfloat16)
         flash_attention(q, kv, kv)
-        assert calls == ["flash"]
+        assert calls == ["mid"]
 
     def test_fp32_short_keeps_xla_window(self, monkeypatch):
         calls = self._spy(monkeypatch)
@@ -330,7 +337,8 @@ class TestShortDispatch:
         assert short_seq_threshold() == 128
         q = jnp.ones((1, 1, 256, 64), jnp.bfloat16)
         flash_attention(q, q, q)
-        assert calls == ["flash"]
+        # shapes pushed out of the short window fall to the next tier
+        assert calls == ["mid"]
 
     def test_explicit_pallas_still_means_flash(self, monkeypatch):
         # the strict flash request must not be silently re-routed

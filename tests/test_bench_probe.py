@@ -1,0 +1,97 @@
+"""bench.py TPU-probe fail-fast: the probe loop's own budget
+(APEX_TPU_BENCH_PROBE_BUDGET) and the same-boot failure cache in
+BENCH_WATCH.json (BENCH_r05 burned 1500 s probing an unreachable TPU
+before the CPU fallback started)."""
+
+import importlib
+import json
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+@pytest.fixture
+def watch_path(tmp_path, monkeypatch):
+    p = str(tmp_path / "BENCH_WATCH.json")
+    monkeypatch.setattr(bench, "BENCH_WATCH_PATH", p)
+    monkeypatch.setattr(bench, "_boot_id", lambda: "boot-a")
+    monkeypatch.setattr(bench, "PROBE_CACHE_S", 3600)
+    return p
+
+
+def test_probe_budget_default_well_under_old_burn():
+    # the r05 gate lost ~1500 s to the probe loop; the new default cap
+    # must sit well under that (and stay env-tunable)
+    assert bench.PROBE_BUDGET <= 900
+
+
+def test_probe_budget_env_override(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_BENCH_PROBE_BUDGET", "42")
+    try:
+        importlib.reload(bench)
+        assert bench.PROBE_BUDGET == 42
+    finally:
+        monkeypatch.delenv("APEX_TPU_BENCH_PROBE_BUDGET")
+        importlib.reload(bench)
+
+
+def test_failure_cache_round_trip(watch_path):
+    assert bench._cached_probe_failure() is None
+    bench._set_probe_failure(
+        {"boot_id": "boot-a", "at": time.time(), "attempts": 3})
+    rec = bench._cached_probe_failure()
+    assert rec is not None and rec["attempts"] == 3
+    bench._set_probe_failure(None)
+    assert bench._cached_probe_failure() is None
+
+
+def test_failure_cache_ignores_other_boot(watch_path):
+    bench._set_probe_failure(
+        {"boot_id": "boot-OLD", "at": time.time(), "attempts": 1})
+    assert bench._cached_probe_failure() is None
+
+
+def test_failure_cache_expires(watch_path):
+    bench._set_probe_failure(
+        {"boot_id": "boot-a", "at": time.time() - 7200, "attempts": 1})
+    assert bench._cached_probe_failure() is None  # older than cache_s
+
+
+def test_cache_disabled_by_env_zero(watch_path, monkeypatch):
+    bench._set_probe_failure(
+        {"boot_id": "boot-a", "at": time.time(), "attempts": 1})
+    monkeypatch.setattr(bench, "PROBE_CACHE_S", 0)
+    # tpu_watch's post-contact bench run sets the env to 0 so a stale
+    # record cannot make it skip its own probe
+    assert bench._cached_probe_failure() is None
+
+
+def test_cache_merge_preserves_capture_record(watch_path):
+    # tpu_watch's capture record must survive the failure cache writes
+    with open(watch_path, "w") as f:
+        json.dump({"captured": True, "result": {"value": 1.0}}, f)
+    bench._set_probe_failure(
+        {"boot_id": "boot-a", "at": time.time(), "attempts": 2})
+    with open(watch_path) as f:
+        d = json.load(f)
+    assert d["captured"] is True and "probe_failure" in d
+    bench._set_probe_failure(None)
+    with open(watch_path) as f:
+        d = json.load(f)
+    assert d["captured"] is True and "probe_failure" not in d
+
+
+def test_corrupt_watch_file_is_tolerated(watch_path):
+    with open(watch_path, "w") as f:
+        f.write("{not json")
+    assert bench._cached_probe_failure() is None
+    bench._set_probe_failure(
+        {"boot_id": "boot-a", "at": time.time(), "attempts": 1})
+    assert bench._cached_probe_failure() is not None

@@ -476,10 +476,19 @@ class TestFp32DispatchWindow:
             calls.append(q.dtype)
             return jnp.zeros(q.shape, q.dtype)
 
+        from apex_tpu.ops import attention_mid as mid_mod
+
         monkeypatch.setattr(attn_mod, "_flash_attention_pallas", fake_pallas)
+        # the mid tier is part of the pallas kernel family: these tests
+        # pin the fp32-vs-kernel WINDOW, not which tier takes the shape
+        # (tier routing has its own tests in test_attention_mid.py)
+        monkeypatch.setattr(
+            mid_mod, "_fmha_mid_pallas",
+            lambda q, *a, **kw: fake_pallas(q, None, None))
         monkeypatch.setattr(plat, "_current_platform", lambda: "tpu")
         monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
         monkeypatch.delenv("APEX_TPU_STRICT_KERNELS", raising=False)
+        monkeypatch.delenv("APEX_TPU_FMHA_MID_MAX_SEQ", raising=False)
         return attn_mod, calls
 
     def test_fp32_short_seq_auto_routes_to_xla(self, monkeypatch):

@@ -43,30 +43,26 @@ def _require_tpu():
         raise SystemExit(f"profile must run on TPU (got {plat})")
 
 
-def build(**cfg_over):
-    from apex_tpu.models import GPTConfig, GPTModel
-    from apex_tpu.optimizers import FusedAdam
-    from apex_tpu.transformer import parallel_state
-    from apex_tpu.transformer.tensor_parallel.layers import state_specs_like
+def _shard_map():
+    # jax.shard_map landed after 0.4.x; the experimental spelling keeps
+    # this harness (and its tp>1 regression test) importable everywhere
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map
 
-    if parallel_state.model_parallel_is_initialized():
-        parallel_state.destroy_model_parallel()
-    mesh = parallel_state.initialize_model_parallel()
-    cfg_kw = dict(
-        vocab_size=VOCAB, num_layers=LAYERS, hidden_size=HIDDEN,
-        num_attention_heads=HEADS, max_position_embeddings=SEQ,
-        compute_dtype=jnp.bfloat16, remat=True,
-    )
-    cfg_kw.update(cfg_over)
-    opt_only = cfg_kw.pop("_opt_only", False)
-    fwd_only = cfg_kw.pop("_fwd_only", False)
-    no_opt = cfg_kw.pop("_no_opt", False)
-    model = GPTModel(GPTConfig(**cfg_kw))
-    params = model.init(jax.random.PRNGKey(0))
-    specs = model.param_specs()
-    opt = FusedAdam(lr=1e-4, master_weights=True)
-    opt_state = opt.init(params)
-    opt_specs = state_specs_like(specs, opt_state)
+    return shard_map
+
+
+def make_step(model, opt, mesh, specs, opt_specs, *, fwd_only=False,
+              opt_only=False, no_opt=False):
+    """Build the jitted train step for one decomposition variant.
+
+    Factored out of :func:`build` so tests can compile the EXACT
+    harness step (notably the ``no_opt`` fwd+bwd-no-optimizer variant,
+    whose tp-varying zero grad-sum was rejected by ``out_specs P()``
+    during the r05 capture) on a small model over a tp>1 mesh.
+    """
 
     def train_step(params, opt_state, tokens, targets):
         if fwd_only:
@@ -94,14 +90,43 @@ def build(**cfg_over):
         new_params, new_opt = opt.step(opt_state, grads, params)
         return new_params, new_opt, loss
 
-    step = jax.jit(
-        jax.shard_map(
+    return jax.jit(
+        _shard_map()(
             train_step, mesh=mesh,
             in_specs=(specs, opt_specs, P("dp"), P("dp")),
             out_specs=(specs, opt_specs, P()),
         ),
         donate_argnums=(0, 1),
     )
+
+
+def build(**cfg_over):
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.tensor_parallel.layers import state_specs_like
+
+    if parallel_state.model_parallel_is_initialized():
+        parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel()
+    cfg_kw = dict(
+        vocab_size=VOCAB, num_layers=LAYERS, hidden_size=HIDDEN,
+        num_attention_heads=HEADS, max_position_embeddings=SEQ,
+        compute_dtype=jnp.bfloat16, remat=True,
+    )
+    cfg_kw.update(cfg_over)
+    opt_only = cfg_kw.pop("_opt_only", False)
+    fwd_only = cfg_kw.pop("_fwd_only", False)
+    no_opt = cfg_kw.pop("_no_opt", False)
+    model = GPTModel(GPTConfig(**cfg_kw))
+    params = model.init(jax.random.PRNGKey(0))
+    specs = model.param_specs()
+    opt = FusedAdam(lr=1e-4, master_weights=True)
+    opt_state = opt.init(params)
+    opt_specs = state_specs_like(specs, opt_state)
+
+    step = make_step(model, opt, mesh, specs, opt_specs,
+                     fwd_only=fwd_only, opt_only=opt_only, no_opt=no_opt)
     place = lambda tree, sp: jax.device_put(
         tree, jax.tree.map(lambda s: NamedSharding(mesh, s), sp,
                            is_leaf=lambda x: isinstance(x, P)))

@@ -179,10 +179,14 @@ def main():
                 # not gate-constrained, so give the children room: the
                 # r5 round-start extras child hit its default 1200 s
                 # budget mid-section and lost the long-seq + t5 rows.
+                # cache override: this run follows a SUCCESSFUL probe,
+                # so a stale same-boot failure record must not make the
+                # bench skip its own probe and fall back to CPU
                 rc, out, err = run(
                     [PY, os.path.join(REPO, "bench.py")], 4500, grace=90,
                     env_over={"APEX_BENCH_TOTAL_BUDGET": "4200",
-                              "APEX_BENCH_CHILD_TIMEOUT": "1800"})
+                              "APEX_BENCH_CHILD_TIMEOUT": "1800",
+                              "APEX_TPU_BENCH_PROBE_CACHE_S": "0"})
                 sys.stderr.write((err or "")[-3000:])
                 line = None
                 for ln in reversed((out or "").strip().splitlines()):
