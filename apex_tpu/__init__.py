@@ -21,6 +21,7 @@ Layout (mirrors the reference's component inventory, see SURVEY.md §2):
 - :mod:`apex_tpu.parallel`       — data-parallel runtime, SyncBatchNorm, LARC
 - :mod:`apex_tpu.transformer`    — Megatron-style tensor/pipeline parallel toolkit
 - :mod:`apex_tpu.contrib`        — xentropy, ASP sparsity, MHA modules, …
+- :mod:`apex_tpu.telemetry`      — runtime metrics (async scalar harvesting), subsystem events, phase traces
 """
 
 __version__ = "0.1.0"
@@ -80,10 +81,10 @@ from apex_tpu import reparameterization  # noqa: E402
 
 # heavier subpackages load lazily: `apex_tpu.transformer`,
 # `apex_tpu.models`, `apex_tpu.contrib`, `apex_tpu.ops`,
-# `apex_tpu.checkpoint`, `apex_tpu.resilience` resolve on first
-# attribute access
+# `apex_tpu.checkpoint`, `apex_tpu.resilience`, `apex_tpu.telemetry`
+# resolve on first attribute access
 _LAZY = ("transformer", "models", "contrib", "ops", "checkpoint",
-         "resilience")
+         "resilience", "telemetry")
 
 
 def __getattr__(name):
@@ -113,6 +114,7 @@ __all__ = [
     "ops",
     "checkpoint",
     "resilience",
+    "telemetry",
     "logger",
     "__version__",
 ]

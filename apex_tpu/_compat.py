@@ -13,13 +13,19 @@ every call site identical across versions instead of scattering
   0.4.x has no vma typing, so the cast is a numeric identity there
   (autodiff under its ``check_rep`` model already keeps per-device
   grads local, which is what ``to="varying"`` exists to force).
+- :func:`shard_map` — the SPMD map itself.  ``jax.shard_map`` landed
+  after 0.4.x, whose spelling is ``jax.experimental.shard_map`` with a
+  ``check_rep`` flag where the newer API has ``check_vma``; the shim
+  takes the common ``(f, mesh, in_specs, out_specs)`` call the
+  examples and tools use and maps ``check=False`` onto whichever flag
+  the installed jax understands.
 """
 
 from __future__ import annotations
 
 import jax
 
-__all__ = ["axis_size", "pcast"]
+__all__ = ["axis_size", "pcast", "shard_map"]
 
 
 def axis_size(axis_name):
@@ -34,3 +40,23 @@ def pcast(x, axis_name, *, to):
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis_name, to=to)
     return x
+
+
+def shard_map(f, mesh, in_specs, out_specs, check: bool = True):
+    """Version-portable ``shard_map(f, mesh=..., in_specs=...,
+    out_specs=...)``.  ``check=False`` disables the vma checker on
+    jax >= 0.6.  On 0.4.x the legacy ``check_rep`` checker is ALWAYS
+    disabled: its replication inference cannot see through the
+    master-weight optimizer update or ring-attention's ``lax.cond``
+    shard skipping and rejects valid programs the newer checker
+    accepts (the same accommodation bench.py's gradsync child and
+    tools/profile_r05.py already make)."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        kw = {} if check else {"check_vma": False}
+        return fn(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs,
+               out_specs=out_specs, check_rep=False)

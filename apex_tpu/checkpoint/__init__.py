@@ -42,6 +42,7 @@ import json
 import logging
 import os
 import re
+import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -50,6 +51,7 @@ import numpy as np
 
 from apex_tpu import csrc
 from apex_tpu.resilience.retry import retry_io
+from apex_tpu.telemetry import events as _events
 
 __all__ = ["save", "restore", "latest_step", "save_step", "restore_step",
            "save_async", "wait_pending_saves", "verify",
@@ -156,6 +158,7 @@ def save(path: str, tree: Any) -> None:
     can never be renamed into place."""
     import pickle
 
+    t0 = time.perf_counter()
     flat, treedef = jax.tree_util.tree_flatten(jax.device_get(tree))
     arrays = [np.asarray(l) for l in flat]
     blob = csrc.flatten(arrays)
@@ -174,6 +177,10 @@ def save(path: str, tree: Any) -> None:
     retry_io(
         lambda: _write_checkpoint_dir(path, manifest, blob, treedef_bytes),
         describe=f"checkpoint save to {path}",
+    )
+    _events.emit(
+        "checkpoint_save", path=path, bytes=int(blob.nbytes),
+        duration_s=round(time.perf_counter() - t0, 4),
     )
 
 
@@ -265,7 +272,23 @@ def verify(path: str, *, deep: bool = True,
     (``FileNotFoundError`` / ``NotADirectoryError`` still report the
     file corrupt) — callers about to take a destructive action on a
     "corrupt" verdict use this so one storage blip cannot condemn a
-    healthy checkpoint."""
+    healthy checkpoint.
+
+    Each completed verification emits a ``checkpoint_verify`` telemetry
+    event (path, deep, ok, failing files, duration) — the integrity
+    outcome stream docs/observability.md describes."""
+    t0 = time.perf_counter()
+    bad = _verify_impl(path, deep=deep, raise_transient=raise_transient)
+    _events.emit(
+        "checkpoint_verify", path=path, deep=deep, ok=not bad,
+        bad_files=list(bad),
+        duration_s=round(time.perf_counter() - t0, 4),
+    )
+    return bad
+
+
+def _verify_impl(path: str, *, deep: bool,
+                 raise_transient: bool) -> List[str]:
     _recover_parked(path)
     try:
         with open(os.path.join(path, _MANIFEST)) as f:
@@ -402,6 +425,7 @@ def restore(path: str, target: Optional[Any] = None,
     :class:`CheckpointCorruptError`."""
     import pickle
 
+    t0 = time.perf_counter()
     _recover_parked(path)
     try:
         with open(os.path.join(path, _MANIFEST)) as f:
@@ -466,6 +490,11 @@ def restore(path: str, target: Optional[Any] = None,
             [np.asarray(r).astype(np.asarray(t).dtype)
              for t, r in zip(t_flat, r_flat)],
         )
+    _events.emit(
+        "checkpoint_restore", path=path, bytes=int(blob.nbytes),
+        verified=verify_integrity,
+        duration_s=round(time.perf_counter() - t0, 4),
+    )
     return tree
 
 
@@ -642,6 +671,10 @@ def restore_latest_valid(root: str, target: Optional[Any] = None
             logger.warning(
                 "skipping corrupt checkpoint %s (%s); "
                 "falling back to an older step", path, e,
+            )
+            _events.emit(
+                "checkpoint_corrupt_fallback", path=path, step=step,
+                error=str(e)[:300],
             )
     return None, None
 

@@ -57,6 +57,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from apex_tpu.telemetry import events as _events
+
 __all__ = [
     "data_parallel_mesh",
     "hierarchical_data_parallel_mesh",
@@ -241,27 +243,33 @@ def all_reduce_gradients(
 
     step = None if comm_state is None else comm_state["step"]
 
+    from apex_tpu.telemetry.spans import phase as _phase
+
     def sync(g, residual, key):
-        orig_dtype = g.dtype
-        if allreduce_always_fp32:
-            g = g.astype(jnp.float32)
-        if gradient_predivide_factor != 1.0:
-            g = g / gradient_predivide_factor
-        if hierarchical:
-            g, new_residual = _hierarchical_psum(
-                g, dcn_axis, ici_axis, compression=cfg,
-                residual=residual, step=step, key=key,
-            )
-        else:
-            g = jax.lax.psum(g, axis_name)
-            new_residual = None
-        if gradient_average:
-            post = world / gradient_predivide_factor
-            if post != 1.0:
-                g = g / post
-        elif gradient_predivide_factor != 1.0:
-            g = g * gradient_predivide_factor
-        return g.astype(orig_dtype), new_residual
+        # tlm.grad_sync: every collective this reduce issues carries
+        # the phase in its HLO metadata, so xprof segments the step's
+        # comm time from its compute (docs/observability.md)
+        with _phase("grad_sync"):
+            orig_dtype = g.dtype
+            if allreduce_always_fp32:
+                g = g.astype(jnp.float32)
+            if gradient_predivide_factor != 1.0:
+                g = g / gradient_predivide_factor
+            if hierarchical:
+                g, new_residual = _hierarchical_psum(
+                    g, dcn_axis, ici_axis, compression=cfg,
+                    residual=residual, step=step, key=key,
+                )
+            else:
+                g = jax.lax.psum(g, axis_name)
+                new_residual = None
+            if gradient_average:
+                post = world / gradient_predivide_factor
+                if post != 1.0:
+                    g = g / post
+            elif gradient_predivide_factor != 1.0:
+                g = g * gradient_predivide_factor
+            return g.astype(orig_dtype), new_residual
 
     from apex_tpu.parallel.overlap import dither_key
 
@@ -282,6 +290,8 @@ def all_reduce_gradients(
             DEFAULT_BUCKET_BYTES if bucket_bytes is None
             else bucket_bytes,  # 0 reaches the >=1 validation, not
         )                       # the default
+        emit_bucket_comm_events(plan, axis_name, cfg,
+                                where="all_reduce_gradients")
         bufs = plan.pack(leaves)
         if comm_state is None:
             out, _ = reduce_bucketed(plan, bufs, cfg, None, None, sync)
@@ -316,6 +326,71 @@ def all_reduce_gradients(
     return jax.tree_util.tree_unflatten(
         treedef, [g for g, _ in synced]
     ), new_state
+
+
+def emit_bucket_comm_events(plan, axis_name, cfg, where: str) -> None:
+    """Trace-time telemetry for a bucketed reduce: one ``comm_bucket``
+    event per bucket, carrying per-leg bytes-on-wire ESTIMATES under
+    the ring model (:func:`apex_tpu.telemetry.events.ring_wire_bytes`
+    — the same formulas ``tools/comm_audit.py`` applies to parsed HLO;
+    the audit's measured JSON stays the ground truth, these events are
+    the live stream's cheap approximation of it).
+
+    Fires while the step is being TRACED — once per compile, with every
+    field a static host int — so the compiled program and the step's
+    wall time are untouched.  Free when no telemetry sink is
+    registered."""
+    if not _events.have_sinks():
+        return
+    from apex_tpu.telemetry.events import ring_wire_bytes
+
+    hierarchical = isinstance(axis_name, (tuple, list))
+    if hierarchical:
+        dcn_axis, ici_axis = axis_name
+        dcn, ici = _axis_size(dcn_axis), _axis_size(ici_axis)
+    else:
+        world = _axis_size(axis_name)
+    for name, b in zip(plan.names, plan.buckets):
+        itemsize = int(np.dtype(b.dtype).itemsize)
+        fields = {
+            "where": where,
+            "bucket": name,
+            "elements": int(b.size),
+            "dtype": str(np.dtype(b.dtype).name),
+            "bytes": int(b.size) * itemsize,
+            "compression": (cfg.method if cfg is not None else "none"),
+        }
+        if hierarchical:
+            # the reduce's actual decomposition: RS(ici) -> AR(dcn,
+            # int8-quantized when compressed) -> AG(ici), over the
+            # ici-padded flat buffer (see _hierarchical_psum)
+            padded = b.size + (-b.size) % ici
+            chunk = padded // ici
+            padded_bytes = padded * itemsize
+            if cfg is None:
+                ar_payload = chunk * itemsize
+            else:
+                # int8 values + one fp32 scale per block (block-padded)
+                qpad = chunk + (-chunk) % cfg.block_size
+                ar_payload = qpad + (qpad // cfg.block_size) * 4
+            fields.update(
+                dcn_size=int(dcn), ici_size=int(ici),
+                rs_ici_wire_bytes=round(
+                    ring_wire_bytes("reduce-scatter", ici, padded_bytes)),
+                ar_dcn_wire_bytes=round(
+                    ring_wire_bytes("all-reduce", dcn, ar_payload)),
+                ag_ici_wire_bytes=round(
+                    ring_wire_bytes("all-gather", ici, padded_bytes,
+                                    result_bytes=padded_bytes)),
+            )
+        else:
+            fields.update(
+                world_size=int(world),
+                ar_wire_bytes=round(
+                    ring_wire_bytes("all-reduce", world,
+                                    b.size * itemsize)),
+            )
+        _events.emit("comm_bucket", **fields)
 
 
 def _check_bucketed_state(plan, comm_state, cfg, dcn_axis,
@@ -859,20 +934,25 @@ class Reducer:
         plan = GradientBuckets.for_tree(
             tree, self.bucket_bytes, dtype=jnp.float32
         )
+        emit_bucket_comm_events(plan, self.axis_name, cfg,
+                                where="reducer")
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         bufs = plan.pack(leaves)
         step = None if comm is None else comm["step"]
 
+        from apex_tpu.telemetry.spans import phase as _phase
+
         def reduce_one(buf, residual, key):
-            if f != 1.0:
-                buf = buf / f
-            if hierarchical:
-                dcn_axis, ici_axis = self.axis_name
-                return _hierarchical_psum(
-                    buf, dcn_axis, ici_axis, compression=cfg,
-                    residual=residual, step=step, key=key,
-                )
-            return jax.lax.psum(buf, self.axis_name), None
+            with _phase("grad_sync"):
+                if f != 1.0:
+                    buf = buf / f
+                if hierarchical:
+                    dcn_axis, ici_axis = self.axis_name
+                    return _hierarchical_psum(
+                        buf, dcn_axis, ici_axis, compression=cfg,
+                        residual=residual, step=step, key=key,
+                    )
+                return jax.lax.psum(buf, self.axis_name), None
 
         out_bufs, new_residuals = reduce_bucketed(
             plan, bufs, cfg,

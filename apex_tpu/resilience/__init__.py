@@ -35,7 +35,11 @@ from apex_tpu.resilience.guard import (  # noqa: F401
     StepGuard,
     locate_nonfinite,
 )
-from apex_tpu.resilience.watchdog import Watchdog, dump_all_stacks  # noqa: F401
+from apex_tpu.resilience.watchdog import (  # noqa: F401
+    Watchdog,
+    dump_all_stacks,
+    read_heartbeat,
+)
 from apex_tpu.resilience import faults  # noqa: F401
 
 
@@ -60,6 +64,7 @@ __all__ = [
     "locate_nonfinite",
     "Watchdog",
     "dump_all_stacks",
+    "read_heartbeat",
     "faults",
     "CheckpointCorruptError",
 ]

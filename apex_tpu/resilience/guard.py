@@ -29,6 +29,8 @@ from typing import Any, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from apex_tpu.telemetry import events as _events
+
 __all__ = ["StepGuard", "GuardVerdict", "DivergenceError",
            "locate_nonfinite"]
 
@@ -194,12 +196,23 @@ class StepGuard:
                 "rolled back to checkpoint step %s",
                 where, self.consecutive_bad, rstep,
             )
+            _events.emit(
+                "guard_rollback", step=step,
+                consecutive_bad=self.consecutive_bad,
+                at_scale_floor=at_floor,
+                restored_step=rstep, restored=state is not None,
+            )
             return GuardVerdict(
                 "rollback", self.consecutive_bad, at_floor, state, rstep
             )
 
         if self.consecutive_bad >= self.raise_after:
             detail = self._diagnose(grads)
+            _events.emit(
+                "guard_diverged", step=step,
+                consecutive_bad=self.consecutive_bad,
+                at_scale_floor=at_floor, detail=detail,
+            )
             raise DivergenceError(
                 f"{self.consecutive_bad} consecutive nonfinite steps"
                 f"{where}"
@@ -217,6 +230,11 @@ class StepGuard:
                 " (loss scale pinned at min_loss_scale)" if at_floor
                 else "",
                 f"; nonfinite leaves: {detail}" if detail else "",
+            )
+            _events.emit(
+                "guard_warn", step=step,
+                consecutive_bad=self.consecutive_bad,
+                at_scale_floor=at_floor, detail=detail,
             )
             return GuardVerdict("warn", self.consecutive_bad, at_floor)
 

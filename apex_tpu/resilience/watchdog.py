@@ -14,10 +14,21 @@ jax/XLA dispatch frames pinpoint a hung collective immediately) and,
 with ``abort=True``, hard-exits the process so the scheduler's
 restart-policy takes over.  One dump per stall episode; a late beat
 re-arms it.
+
+Externally visible liveness: with a ``heartbeat_file`` (or
+``$APEX_TPU_HEARTBEAT_FILE``) each :meth:`beat` also writes a tiny
+JSON record — ``{"at": <unix>, "pid": ..., "step": ...}`` — atomically
+(tmp + rename) and throttled to ~1 write/s, where out-of-process
+observers read it: ``tools/tpu_watch.py`` reports the trainer's
+heartbeat age while it waits on the chip pool, so "the training job is
+alive but stalled" and "the training job is gone" are distinguishable
+from outside.  Stall detections additionally emit a
+``watchdog_stall`` telemetry event.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import signal
@@ -27,9 +38,36 @@ import time
 import traceback
 from typing import Callable, Optional, TextIO
 
-__all__ = ["Watchdog"]
+from apex_tpu.telemetry import events as _events
+
+__all__ = ["Watchdog", "read_heartbeat"]
 
 logger = logging.getLogger("apex_tpu.resilience")
+
+#: Throttle for heartbeat-file writes: beats may come thousands/s in a
+#: tight loop; liveness observers need ~1 Hz.
+HEARTBEAT_WRITE_INTERVAL_S = 1.0
+
+
+def read_heartbeat(path: Optional[str] = None) -> Optional[dict]:
+    """Read a heartbeat file written by :meth:`Watchdog.beat`
+    (``$APEX_TPU_HEARTBEAT_FILE`` when ``path`` is None); returns the
+    record with an added ``age_s``, or None when absent/unreadable —
+    the reader's contract is best-effort, never raising."""
+    path = path or os.environ.get("APEX_TPU_HEARTBEAT_FILE")
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        if not isinstance(rec, dict) or "at" not in rec:
+            return None
+        rec["age_s"] = max(0.0, time.time() - float(rec["at"]))
+        return rec
+    except (OSError, ValueError, TypeError, KeyError):
+        # TypeError covers a malformed "at" (null/list) — the contract
+        # is best-effort, never raising
+        return None
 
 
 def dump_all_stacks(stream: Optional[TextIO] = None,
@@ -72,6 +110,11 @@ class Watchdog:
         Optional callback ``on_stall(elapsed_s, dump_text)`` invoked on
         each stall detection, before any abort.  Exceptions in it are
         logged, never raised, and never cancel the abort.
+    heartbeat_file:
+        Where :meth:`beat` mirrors liveness for out-of-process readers
+        (:func:`read_heartbeat`, ``tools/tpu_watch.py``).  Defaults to
+        ``$APEX_TPU_HEARTBEAT_FILE``; None/unset disables the mirror
+        (the in-process stall detection is unaffected).
 
     Use as a context manager around the training loop, beating once per
     step::
@@ -92,6 +135,7 @@ class Watchdog:
         abort: bool = False,
         stream: Optional[TextIO] = None,
         on_stall: Optional[Callable[[float, str], None]] = None,
+        heartbeat_file: Optional[str] = None,
     ):
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
@@ -104,8 +148,14 @@ class Watchdog:
         self.abort = abort
         self.stream = stream
         self.on_stall = on_stall
+        self.heartbeat_file = (
+            heartbeat_file
+            if heartbeat_file is not None
+            else os.environ.get("APEX_TPU_HEARTBEAT_FILE")
+        )
         self.stall_count = 0
         self._last_beat = time.monotonic()
+        self._last_hb_write = 0.0
         self._stop = threading.Event()
         self._tripped = False  # one dump per stall episode
         self._thread: Optional[threading.Thread] = None
@@ -137,12 +187,32 @@ class Watchdog:
         return False
 
     # ---------------------------------------------------------- heartbeat
-    def beat(self) -> None:
+    def beat(self, step: Optional[int] = None) -> None:
         """Mark the loop alive (call once per step, *after* device work
         lands — beat before ``block_until_ready`` and a hung collective
-        looks healthy)."""
+        looks healthy).  With a heartbeat file configured, mirrors
+        liveness there (throttled, atomic tmp+rename) so out-of-process
+        observers see ``{"at", "pid", "step"}``."""
         self._last_beat = time.monotonic()
         self._tripped = False
+        hb = self.heartbeat_file
+        if hb is None:
+            return
+        now = time.time()
+        if now - self._last_hb_write < HEARTBEAT_WRITE_INTERVAL_S:
+            return
+        self._last_hb_write = now
+        rec = {"at": now, "pid": os.getpid()}
+        if step is not None:
+            rec["step"] = int(step)
+        tmp = f"{hb}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, hb)
+        except OSError as e:
+            # liveness mirroring must never break the loop it observes
+            logger.warning("heartbeat write to %s failed: %s", hb, e)
 
     # ------------------------------------------------------------- worker
     def _run(self) -> None:
@@ -160,6 +230,11 @@ class Watchdog:
             logger.error(
                 "watchdog: step stalled for %.1fs (deadline %.1fs)",
                 elapsed, self.deadline_s,
+            )
+            _events.emit(
+                "watchdog_stall", elapsed_s=round(elapsed, 1),
+                deadline_s=self.deadline_s, stall_count=self.stall_count,
+                will_abort=self.abort,
             )
             if self.on_stall is not None:
                 try:

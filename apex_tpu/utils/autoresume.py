@@ -27,6 +27,7 @@ import signal
 from typing import Any, Optional, Set, Tuple
 
 from apex_tpu import checkpoint as ckpt
+from apex_tpu.telemetry import events as _events
 
 __all__ = ["AutoResume"]
 
@@ -74,8 +75,10 @@ class AutoResume:
         checkpoint both verifies and loads."""
         state, step = ckpt.restore_latest_valid(self.root, target=target)
         if step is None:
+            _events.emit("autoresume_fresh", root=self.root)
             return None, 0
         self._known_valid.add(step)
+        _events.emit("autoresume_resume", root=self.root, step=step)
         return state, step
 
     # -------------------------------------------------------------- save
@@ -131,9 +134,12 @@ class AutoResume:
                 logger.warning(
                     "autoresume GC removing corrupt checkpoint %s", path
                 )
+                _events.emit("autoresume_gc", step=step, corrupt=True)
             elif step == just_saved:  # invariant backstop: never delete it
                 kept += 1
                 continue
+            else:
+                _events.emit("autoresume_gc", step=step, corrupt=False)
             shutil.rmtree(path, ignore_errors=True)
             self._known_valid.discard(step)
 

@@ -17,7 +17,6 @@ steps; swap :func:`synthetic_task` for a real tokenized dataset.
 """
 
 import argparse
-import time
 
 import jax
 import jax.numpy as jnp
@@ -25,8 +24,11 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu import amp
+from apex_tpu._compat import shard_map
 from apex_tpu.models import BertConfig, BertModel
 from apex_tpu.optimizers import FusedAdam
+from apex_tpu.telemetry.metrics import MetricsLogger, StepStats
+from apex_tpu.telemetry.spans import phase
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.tensor_parallel.layers import state_specs_like
 
@@ -76,6 +78,11 @@ def main(argv=None):
                          "collectives (requires --dp-ici-size)")
     ap.add_argument("--bucket-mb", type=float, default=4.0,
                     help="bucket size in MiB for --overlap-grad-sync")
+    ap.add_argument("--log-every", type=int, default=50,
+                    help="telemetry flush cadence: loss/acc resolve "
+                         "every N steps (no per-step host sync)")
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="append structured step metrics here")
     args = ap.parse_args(argv)
 
     hier = args.dp_ici_size is not None
@@ -149,8 +156,9 @@ def main(argv=None):
         comm_state, comm_specs = {}, {}
 
     def train_step(p, s, comm, tokens, mask, labels):
-        (loss, acc), grads = jax.value_and_grad(
-            cls_loss, has_aux=True)(p, tokens, mask, labels)
+        with phase("fwd_bwd"):
+            (loss, acc), grads = jax.value_and_grad(
+                cls_loss, has_aux=True)(p, tokens, mask, labels)
         if hier:
             from apex_tpu.parallel import all_reduce_gradients
 
@@ -166,13 +174,16 @@ def main(argv=None):
                     overlap_grad_sync=args.overlap_grad_sync,
                     bucket_bytes=bucket_bytes)
         else:
-            grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
-        p, s = opt.step(s, grads, p)
+            with phase("grad_sync"):
+                grads = jax.tree.map(
+                    lambda g: jax.lax.pmean(g, "dp"), grads)
+        with phase("optimizer"):
+            p, s = opt.step(s, grads, p)
         return p, s, comm, loss, acc
 
     data_spec = P(data_axes if hier else "dp")
     jstep = jax.jit(
-        jax.shard_map(
+        shard_map(
             train_step, mesh=mesh,
             in_specs=(specs, opt_specs, comm_specs,
                       data_spec, data_spec, data_spec),
@@ -180,7 +191,7 @@ def main(argv=None):
         ),
         donate_argnums=(0, 1),
     )
-    jeval = jax.jit(jax.shard_map(
+    jeval = jax.jit(shard_map(
         cls_loss, mesh=mesh,
         in_specs=(specs, data_spec, data_spec, data_spec),
         out_specs=(P(), P()),
@@ -201,21 +212,26 @@ def main(argv=None):
                                args.eval_batches, global_batch,
                                args.seq, args.vocab)
 
-    t0, timed = None, 0
-    for i in range(args.steps):
-        tokens, mask, labels = train_pool[i % len(train_pool)]
-        p, s, cst, loss, acc = jstep(p, s, cst, tokens, mask, labels)
-        lv = float(loss)
-        if i == 0:
-            t0 = time.perf_counter()
-        else:
-            timed += 1
-        if i % 50 == 0 or i == args.steps - 1:
-            print(f"step {i}: loss {lv:.4f}  train-acc {float(acc):.3f}")
-    if timed and t0:
-        dt = time.perf_counter() - t0
-        print(f"{dt / timed * 1e3:.1f} ms/step  "
-              f"{global_batch * timed / dt:,.0f} seq/s")
+    # async harvesting: loss/acc stay device futures between flushes —
+    # no per-step host sync; ms/step excludes the first-step compile
+    # (stats.begin blocks on step 0, the clock starts after)
+    stats = StepStats(tokens_per_step=global_batch, unit="seq")
+    with MetricsLogger(jsonl_path=args.metrics_jsonl,
+                       flush_every=args.log_every, stats=stats,
+                       run="bert_finetune") as tlm:
+        loss = acc = None
+        for i in range(args.steps):
+            tokens, mask, labels = train_pool[i % len(train_pool)]
+            p, s, cst, loss, acc = jstep(p, s, cst, tokens, mask, labels)
+            if i == 0:
+                stats.begin((loss, acc))
+            else:
+                stats.tick()
+            tlm.log_scalars(i, loss=loss, train_acc=acc)
+        summary = stats.summary((loss, acc))
+    if summary.get("timed_steps"):
+        print(f"{summary['ms_per_step']:.1f} ms/step  "
+              f"{summary['tokens_per_sec']:,.0f} seq/s")
 
     accs = [float(jeval(p, *b)[1]) for b in eval_pool]
     print(f"eval accuracy: {np.mean(accs):.3f}")

@@ -13,7 +13,14 @@ assemble from Megatron pieces, wired TPU-native end to end:
   all-gather sharded ``DistributedFusedAdam``;
 - dynamic loss scaling with model-parallel overflow consensus (fp16
   levels only — bf16 needs none);
-- async, atomic checkpointing + SIGTERM-safe autoresume.
+- async, atomic checkpointing + SIGTERM-safe autoresume;
+- structured telemetry (apex_tpu.telemetry): the loss is held as an
+  unresolved device future and resolved only at the ``--log-every``
+  flush cadence — NO per-step ``float(loss)`` host sync, so XLA's
+  async dispatch stays ahead of the host — with live tokens/s + MFU,
+  subsystem events (checkpoint/guard/comm) in the ``--metrics-jsonl``
+  stream, phase-annotated traces and an on-demand trace trigger
+  (touch ``<--trace-dir>/TRACE_REQUEST`` mid-run).
 
 Synthetic token stream by default; swap :func:`batches` for a real
 tokenized corpus.
@@ -24,7 +31,6 @@ tokenized corpus.
 
 import argparse
 import os
-import time
 
 import jax
 import jax.numpy as jnp
@@ -32,8 +38,15 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu import amp
+from apex_tpu._compat import shard_map
 from apex_tpu.models import GPTConfig, GPTModel
 from apex_tpu.optimizers import FusedAdam
+from apex_tpu.telemetry.metrics import (
+    MetricsLogger,
+    StepStats,
+    transformer_flops_per_token,
+)
+from apex_tpu.telemetry.spans import TraceTrigger, phase
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.amp import model_parallel_all_finite
 from apex_tpu.transformer.tensor_parallel.layers import state_specs_like
@@ -143,6 +156,25 @@ def main(argv=None):
                          "synthetic stream when omitted")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--save-every", type=int, default=25)
+    ap.add_argument("--log-every", type=int, default=10,
+                    help="telemetry flush cadence: device scalars "
+                         "(loss) resolve and print every N steps — the "
+                         "ONLY per-step host sync knob (1 = the old "
+                         "synchronous behaviour)")
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="append structured step metrics + subsystem "
+                         "events here (tools/metrics_report.py reads "
+                         "it)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="arm the on-demand trace trigger: touch "
+                         "<trace-dir>/TRACE_REQUEST mid-run to capture "
+                         "an xplane window (APEX_TPU_TRACE_STEPS "
+                         "steps) without restarting")
+    ap.add_argument("--watchdog-s", type=float, default=None,
+                    help="stall watchdog deadline in seconds (dumps "
+                         "all-thread stacks on heartbeat silence; "
+                         "heartbeats mirror to "
+                         "$APEX_TPU_HEARTBEAT_FILE for tpu_watch)")
     args = ap.parse_args(argv)
 
     hier = args.dp_ici_size is not None
@@ -217,7 +249,7 @@ def main(argv=None):
             compression=comp,
         )
         opt_specs = opt.state_specs(model_axes=("pp", "tp"))
-        init_opt = jax.jit(jax.shard_map(
+        init_opt = jax.jit(shard_map(
             opt.init, mesh=mesh, in_specs=(specs,), out_specs=opt_specs))
     else:
         opt = FusedAdam(lr=args.lr,
@@ -261,38 +293,44 @@ def main(argv=None):
 
     def train_step(params, opt_state, amp_state, comm_state,
                    tokens, targets):
-        if pp_path:
-            loss, grads = model.pipeline_1f1b_grads(
-                params, tokens, targets, args.num_micro)
-            if use_scaler:
-                # fp16 + pipeline: scale the already-computed grads so
-                # the scaler's overflow-skip + adjustment state machine
-                # runs (infs survive finite scaling).  This protects
-                # against overflow but NOT bwd underflow — the bf16
-                # levels (the TPU default) are the recommended pipeline
-                # precision and need no scaler at all
-                s = amp_state.scaler_states[0].loss_scale
-                grads = jax.tree.map(
-                    lambda g: g * s.astype(g.dtype), grads)
-        else:
-            def loss_fn(p):
-                loss = model.loss(p, tokens, targets)
-                return mp.scale_loss(amp_state, loss), loss
+        # tlm.* phase scopes: xprof segments the compiled step's
+        # timeline by phase (fwd_bwd / grad_sync / optimizer) instead
+        # of by mangled fusion names — see docs/observability.md
+        with phase("fwd_bwd"):
+            if pp_path:
+                loss, grads = model.pipeline_1f1b_grads(
+                    params, tokens, targets, args.num_micro)
+                if use_scaler:
+                    # fp16 + pipeline: scale the already-computed grads
+                    # so the scaler's overflow-skip + adjustment state
+                    # machine runs (infs survive finite scaling).  This
+                    # protects against overflow but NOT bwd underflow —
+                    # the bf16 levels (the TPU default) are the
+                    # recommended pipeline precision and need no scaler
+                    # at all
+                    s = amp_state.scaler_states[0].loss_scale
+                    grads = jax.tree.map(
+                        lambda g: g * s.astype(g.dtype), grads)
+            else:
+                def loss_fn(p):
+                    loss = model.loss(p, tokens, targets)
+                    return mp.scale_loss(amp_state, loss), loss
 
-            grads, loss = jax.grad(loss_fn, has_aux=True)(params)
-            loss = jax.lax.pmean(loss, "dp")
-            if not args.zero and not hier:
-                # spec-aware dp sync: replicated leaves pmean (a no-op
-                # re-establishing invariance — model.loss's internal
-                # pmean already made their grads globally complete);
-                # dp-SHARDED leaves (MoE experts riding dp as ep) are
-                # already final via the all_to_all transpose and must
-                # NOT be averaged elementwise across unrelated experts.
-                # ZeRO skips this: its reduce-scatter is the reduction
-                from apex_tpu.transformer.parallel_state import (
-                    spec_axis_names,
-                )
+                grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+                loss = jax.lax.pmean(loss, "dp")
+        if not pp_path and not args.zero and not hier:
+            # spec-aware dp sync: replicated leaves pmean (a no-op
+            # re-establishing invariance — model.loss's internal
+            # pmean already made their grads globally complete);
+            # dp-SHARDED leaves (MoE experts riding dp as ep) are
+            # already final via the all_to_all transpose and must
+            # NOT be averaged elementwise across unrelated experts.
+            # ZeRO skips this: its reduce-scatter is the reduction
+            from apex_tpu.transformer.parallel_state import (
+                spec_axis_names,
+            )
 
+            with phase("grad_sync"):
                 grads = jax.tree.map(
                     lambda g, sp: (g if "dp" in spec_axis_names(sp)
                                    else jax.lax.pmean(g, "dp")),
@@ -346,31 +384,33 @@ def main(argv=None):
                     grads, axis_name=data_axes, compression=comp,
                     overlap_grad_sync=args.overlap_grad_sync,
                     bucket_bytes=bucket_bytes)
-        if args.clip_grad is not None:
-            # AFTER unscale (clip sees true-magnitude grads), BEFORE the
-            # optimizer; duplicate-aware over the mesh (tp/pp shards +
-            # expert-dp leaves psum, replicated leaves count once)
-            grads, _ = clip_grad_norm(grads, specs, args.clip_grad)
-        if args.zero:
-            # expert grads are optimizer-ready in BOTH paths here: the
-            # pipeline's data_reduce applies the 1/n itself, and the
-            # pp=1 path's model.loss pmeans the loss inside the
-            # differentiated function (the all_to_all transpose then
-            # delivers the final global-mean gradient) — so the local
-            # path must not divide again
-            new_params, new_opt = opt.step(
-                opt_state, grads, params, grads_finite=finite,
-                local_grads_prenormalized=True)
-            new_params = reestablish_replicated(new_params, specs)
-        else:
-            new_params, new_opt = opt.step(
-                opt_state, grads, params, grads_finite=finite)
+        with phase("optimizer"):
+            if args.clip_grad is not None:
+                # AFTER unscale (clip sees true-magnitude grads),
+                # BEFORE the optimizer; duplicate-aware over the mesh
+                # (tp/pp shards + expert-dp leaves psum, replicated
+                # leaves count once)
+                grads, _ = clip_grad_norm(grads, specs, args.clip_grad)
+            if args.zero:
+                # expert grads are optimizer-ready in BOTH paths here:
+                # the pipeline's data_reduce applies the 1/n itself,
+                # and the pp=1 path's model.loss pmeans the loss inside
+                # the differentiated function (the all_to_all transpose
+                # then delivers the final global-mean gradient) — so
+                # the local path must not divide again
+                new_params, new_opt = opt.step(
+                    opt_state, grads, params, grads_finite=finite,
+                    local_grads_prenormalized=True)
+                new_params = reestablish_replicated(new_params, specs)
+            else:
+                new_params, new_opt = opt.step(
+                    opt_state, grads, params, grads_finite=finite)
         return new_params, new_opt, amp_state, new_comm, loss
 
     amp_specs = jax.tree.map(lambda _: P(), amp_state)
     data_spec = P(data_axes if hier else "dp")
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             train_step, mesh=mesh,
             in_specs=(specs, opt_specs, amp_specs, comm_specs,
                       data_spec, data_spec),
@@ -414,40 +454,79 @@ def main(argv=None):
             if args.data else
             batches(np.random.default_rng(0), 8, global_batch,
                     args.seq, args.vocab))
-    t0, timed, lv = None, 0, float("nan")
-    for i in range(start, args.steps):
-        tokens, targets = pool[i % len(pool)]
-        placed, opt_state, amp_state, comm_state, loss = step(
-            placed, opt_state, amp_state, comm_state, tokens, targets)
-        lv = float(loss)  # host sync closes the step
-        if i == start:
-            t0 = time.perf_counter()
-        else:
-            timed += 1
-        if i % 10 == 0 or i == args.steps - 1:
-            print(f"step {i}: loss {lv:.4f}")
-        if ar is not None:
-            # build the (expensive, device_get-ing) state dict only on
-            # ticks maybe_save would actually write
-            due = (i > 0 and i % args.save_every == 0) \
-                or ar.termination_requested() or i == args.steps - 1
-            if due:
-                state = {"params": jax.device_get(placed),
-                         "opt": jax.device_get(opt_state),
-                         "amp": mp.state_dict(amp_state),
-                         "step": np.int64(i)}
-                if use_comm:
-                    state["comm"] = jax.device_get(comm_state)
-                saved = ar.maybe_save(i, state,
-                                      force=(i == args.steps - 1))
-                if saved and ar.termination_requested():
-                    print("termination requested; checkpoint saved")
-                    return {"loss": lv, "stopped_at": i}
-    if timed and t0:
-        dt = time.perf_counter() - t0
-        tps = global_batch * args.seq * timed / dt
-        print(f"{dt / timed * 1e3:.1f} ms/step  {tps:,.0f} tokens/s")
-    return {"loss": lv, "params": placed}
+
+    # telemetry: loss stays an unresolved device future between
+    # flushes; tokens/s + MFU come from the same FLOP model bench.py /
+    # tools/scale_mfu.py report, timed from AFTER the first step so the
+    # XLA compile never pollutes ms/step
+    n_params = sum(int(np.prod(jnp.shape(l)))
+                   for l in jax.tree.leaves(params))
+    stats = StepStats(
+        tokens_per_step=global_batch * args.seq,
+        flops_per_token=transformer_flops_per_token(
+            n_params, args.layers, args.hidden, args.seq),
+    )
+    tlm = MetricsLogger(jsonl_path=args.metrics_jsonl,
+                        flush_every=args.log_every, stats=stats,
+                        run="gpt_pretrain")
+    tlm.attach_events()  # checkpoint/comm/guard events join the stream
+    trig = TraceTrigger(trace_dir=args.trace_dir) \
+        if (args.trace_dir or os.environ.get("APEX_TPU_TRACE_DIR")) \
+        else None
+    wd = None
+    if args.watchdog_s:
+        from apex_tpu.resilience import Watchdog
+
+        wd = Watchdog(deadline_s=args.watchdog_s).start()
+    loss = jnp.float32(float("nan"))
+    try:
+        for i in range(start, args.steps):
+            with tlm.timing("data"):
+                tokens, targets = pool[i % len(pool)]
+            placed, opt_state, amp_state, comm_state, loss = step(
+                placed, opt_state, amp_state, comm_state, tokens, targets)
+            if i == start:
+                stats.begin(loss)  # blocks once: compile excluded
+            else:
+                stats.tick()
+            tlm.log_scalars(i, loss=loss)  # async: resolves at cadence
+            if trig is not None:
+                trig.poll(i)
+            if wd is not None:
+                wd.beat(step=i)
+            if ar is not None:
+                # build the (expensive, device_get-ing) state dict only
+                # on ticks maybe_save would actually write
+                due = (i > 0 and i % args.save_every == 0) \
+                    or ar.termination_requested() or i == args.steps - 1
+                if due:
+                    with tlm.timing("checkpoint"), phase("checkpoint"):
+                        state = {"params": jax.device_get(placed),
+                                 "opt": jax.device_get(opt_state),
+                                 "amp": mp.state_dict(amp_state),
+                                 "step": np.int64(i)}
+                        if use_comm:
+                            state["comm"] = jax.device_get(comm_state)
+                        saved = ar.maybe_save(i, state,
+                                              force=(i == args.steps - 1))
+                    if saved and ar.termination_requested():
+                        print("termination requested; checkpoint saved")
+                        return {"loss": float(loss), "stopped_at": i}
+        summary = stats.summary(loss)  # blocks on the final step
+        tlm.flush()
+        if summary.get("timed_steps"):
+            line = (f"{summary['ms_per_step']:.1f} ms/step  "
+                    f"{summary['tokens_per_sec']:,.0f} tokens/s")
+            if "mfu" in summary:
+                line += f"  mfu {summary['mfu']:.3f}"
+            print(line)
+        return {"loss": float(loss), "params": placed}
+    finally:
+        if wd is not None:
+            wd.stop()
+        if trig is not None:
+            trig.close()
+        tlm.close()  # flushes, deregisters the event sink, closes fd
 
 
 if __name__ == "__main__":

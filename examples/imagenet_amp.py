@@ -27,7 +27,6 @@ Feature-for-feature with the reference trainer, TPU-native:
 
 import argparse
 import os
-import time
 
 import jax
 import jax.numpy as jnp
@@ -35,8 +34,10 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu import checkpoint
+from apex_tpu._compat import shard_map
 from apex_tpu.models.resnet import ResNet, ResNetConfig
 from apex_tpu.optimizers import FusedSGD
+from apex_tpu.telemetry.metrics import MetricsLogger, StepStats
 from apex_tpu.transformer import parallel_state
 
 
@@ -119,14 +120,14 @@ def build_steps(model, opt, num_classes, mesh, param_tree, opt_tree,
                 jax.lax.psum(jnp.stack([c1, c5, n]), "dp"))
 
     train = jax.jit(
-        jax.shard_map(
+        shard_map(
             train_step, mesh=mesh,
             in_specs=(pspec, ospec, sspec, P("dp"), P("dp")),
             out_specs=(pspec, ospec, sspec, P(), P()),
         ),
         donate_argnums=(0, 1, 2),
     )
-    evaluate = jax.jit(jax.shard_map(
+    evaluate = jax.jit(shard_map(
         eval_step, mesh=mesh,
         in_specs=(pspec, sspec, P("dp"), P("dp")),
         out_specs=(P(), P()),
@@ -166,6 +167,9 @@ def main(argv=None):
     ap.add_argument("--evaluate", action="store_true",
                     help="validation only (with --resume to score a "
                          "saved model)")
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="append structured step metrics + checkpoint "
+                         "events here")
     args = ap.parse_args(argv)
 
     mesh = parallel_state.initialize_model_parallel()
@@ -222,25 +226,46 @@ def main(argv=None):
         print(f"eval: loss {loss:.3f}  prec@1 {p1:.2f}  prec@5 {p5:.2f}")
         return {"prec1": p1, "prec5": p5}
 
+    # telemetry: per-step loss/meters stay device futures; ONE batched
+    # device_get resolves the whole epoch (the old loop synced twice
+    # per step: float(loss) + np.asarray(meters)).  ms/step excludes
+    # the first step of each epoch (only epoch 0's includes a compile,
+    # but the exclusion is uniform — the same timing contract as the
+    # gpt/bert/t5 trainers)
+    stats = StepStats(tokens_per_step=global_batch, unit="img")
+    # close() (the with-exit) deregisters the logger from the event
+    # bus, so an exception mid-epoch cannot leak the sink or the fd
+    with MetricsLogger(jsonl_path=args.metrics_jsonl, console=False,
+                       flush_every=max(args.steps_per_epoch, 1),
+                       run="imagenet_amp").attach_events() as tlm:
+        return _train_epochs(
+            args, tlm, stats, train, evaluate, train_pool, val_pool,
+            params, opt_state, bn_stats, start_epoch, best_prec1,
+            global_batch)
+
+
+def _train_epochs(args, tlm, stats, train, evaluate, train_pool,
+                  val_pool, params, opt_state, bn_stats, start_epoch,
+                  best_prec1, global_batch):
     for epoch in range(start_epoch, args.epochs):
-        tot = np.zeros(3)
-        losses = []
-        t0 = None
+        held = []  # (loss, meters) device pairs, resolved at epoch end
         for i in range(args.steps_per_epoch):
             images, labels = train_pool[i % len(train_pool)]
             params, opt_state, bn_stats, loss, meters = train(
                 params, opt_state, bn_stats, images, labels
             )
-            losses.append(float(loss))  # host sync: closes the step
-            tot += np.asarray(meters)
+            held.append((loss, meters))
             if i == 0:
-                # first step may include XLA compilation: time from here
-                t0, timed_steps = time.perf_counter(), 0
+                stats.begin((loss, meters))  # blocks once per epoch
             else:
-                timed_steps += 1
-        dt = max(time.perf_counter() - t0, 1e-9)
-        ips = (global_batch * timed_steps / dt if timed_steps
-               else float("nan"))
+                stats.tick()
+            tlm.log_scalars(epoch * args.steps_per_epoch + i, loss=loss)
+        summary = stats.summary(held[-1] if held else None)
+        resolved = jax.device_get(held)  # one transfer for the epoch
+        losses = [float(l) for l, _ in resolved]
+        tot = np.sum([np.asarray(m) for _, m in resolved], axis=0) \
+            if resolved else np.zeros(3)
+        ips = summary.get("tokens_per_sec", float("nan"))
         c1, c5, n = tot
         print(f"epoch {epoch}: loss {np.mean(losses):.3f}  "
               f"prec@1 {100 * c1 / n:.2f}  prec@5 {100 * c5 / n:.2f}  "

@@ -22,8 +22,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from apex_tpu._compat import shard_map
 from apex_tpu.models import T5Config, T5Model
 from apex_tpu.optimizers import FusedAdam
+from apex_tpu.telemetry.metrics import MetricsLogger, StepStats
+from apex_tpu.telemetry.spans import phase
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.tensor_parallel.layers import state_specs_like
 
@@ -33,6 +36,11 @@ STEPS = 60
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
+    ap.add_argument("--log-every", type=int, default=10,
+                    help="telemetry flush cadence: the loss resolves "
+                         "every N steps (no per-step host sync)")
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="append structured step metrics here")
     ap.add_argument("--dp-ici-size", type=int, default=None,
                     help="hierarchical data parallelism: replicas per "
                          "fast-interconnect group")
@@ -135,10 +143,11 @@ def main(argv=None):
         # Hierarchical dp: the internal pmean rides the size-1 dummy
         # axis, so the data mean over (dcn, ici) happens explicitly —
         # RS(ici) -> AR(dcn, int8 when compressed) -> AG(ici)
-        loss, grads = jax.value_and_grad(
-            lambda p: model.pipeline_loss(p, enc, dec, tgt,
-                                          num_microbatches=2)
-        )(params)
+        with phase("fwd_bwd"):
+            loss, grads = jax.value_and_grad(
+                lambda p: model.pipeline_loss(p, enc, dec, tgt,
+                                              num_microbatches=2)
+            )(params)
         if hier:
             from apex_tpu.parallel import all_reduce_gradients
 
@@ -154,11 +163,12 @@ def main(argv=None):
                     grads, axis_name=data_axes, compression=comp,
                     overlap_grad_sync=args.overlap_grad_sync,
                     bucket_bytes=bucket_bytes)
-        params, opt_state = opt.step(opt_state, grads, params)
+        with phase("optimizer"):
+            params, opt_state = opt.step(opt_state, grads, params)
         return params, opt_state, comm, loss
 
     data_spec = P(data_axes if hier else "dp")
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         train_step, mesh=mesh,
         in_specs=(specs, opt_specs, comm_specs,
                   data_spec, data_spec, data_spec),
@@ -176,10 +186,28 @@ def main(argv=None):
 
     p, s = place(params, specs), place(opt_state, opt_specs)
     cst = place(comm_state, comm_specs)
-    for i in range(STEPS):
-        p, s, cst, loss = step(p, s, cst, enc_tokens, dec_tokens, targets)
-        if i % 10 == 0 or i == STEPS - 1:
-            print(f"step {i:3d}  loss {float(loss):.4f}")
+    # async harvesting: the loss stays a device future between flushes
+    # — no per-step host sync; ms/step excludes the first-step compile
+    # (stats.begin blocks on step 0, the clock starts after), the same
+    # timing contract as the other example trainers
+    stats = StepStats(tokens_per_step=dec_tokens.shape[0]
+                      * dec_tokens.shape[1])
+    with MetricsLogger(jsonl_path=args.metrics_jsonl,
+                       flush_every=args.log_every, stats=stats,
+                       run="t5_pipeline") as tlm:
+        loss = None
+        for i in range(STEPS):
+            p, s, cst, loss = step(p, s, cst, enc_tokens, dec_tokens,
+                                   targets)
+            if i == 0:
+                stats.begin(loss)
+            else:
+                stats.tick()
+            tlm.log_scalars(i, loss=loss)
+        summary = stats.summary(loss)
+    if summary.get("timed_steps"):
+        print(f"{summary['ms_per_step']:.1f} ms/step  "
+              f"{summary['tokens_per_sec']:,.0f} dec tokens/s")
     print("done")
 
 

@@ -66,10 +66,12 @@ class TestResNet:
             x = jax.random.normal(jax.random.PRNGKey(1), (16, 16, 16, 3))
             ref_logits, _ = model_local.apply(params, stats, x, training=True)
 
+            from apex_tpu._compat import shard_map
+
             pspec = jax.tree.map(lambda _: P(), params)
             sspec = jax.tree.map(lambda _: P(), stats)
             fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     lambda p, s, x: model_sync.apply(p, s, x, training=True),
                     mesh=mesh,
                     in_specs=(pspec, sspec, P("dp")),

@@ -186,14 +186,14 @@ def parse_collectives(hlo_text: str):
 
 
 def _wire_bytes(rec) -> float:
+    # the ONE ring bytes-on-wire model, shared with the live telemetry
+    # stream's per-bucket comm events (they estimate, this measures —
+    # delegating keeps the two from ever drifting)
+    from apex_tpu.telemetry.events import ring_wire_bytes
+
     g = max((len(grp) for grp in rec["replica_groups"]), default=1)
-    if rec["op"] == "all-reduce":
-        return 2.0 * (g - 1) / g * rec["operand_bytes"]
-    if rec["op"] == "all-gather":
-        return (g - 1) / g * rec["result_bytes"]
-    if rec["op"] in ("reduce-scatter", "all-to-all"):
-        return (g - 1) / g * rec["operand_bytes"]
-    return float(rec["operand_bytes"])  # collective-permute
+    return ring_wire_bytes(rec["op"], g, rec["operand_bytes"],
+                           result_bytes=rec["result_bytes"])
 
 
 def _mesh_coords(mesh, dcn_axis="dcn", ici_axis="ici"):

@@ -1,0 +1,270 @@
+"""metrics_report: telemetry JSONL → run summary.
+
+Reads the record stream a :class:`apex_tpu.telemetry.MetricsLogger`
+appends (``--metrics-jsonl`` on the example trainers; schema in
+docs/observability.md) and reports what a final tokens/s number cannot:
+
+- **throughput/MFU trajectory** — every per-flush ``throughput``
+  record, plus headline stats (best / mean / final window), in the
+  same ``metric``/``value``/``unit`` shape the ``BENCH_*.json``
+  records use so the two are directly comparable (``--bench`` diffs
+  against one);
+- **step-time breakdown** — host-side phase timings (the logger's
+  ``timing()`` meters: data / checkpoint / ...) as per-step
+  milliseconds next to the measured ms/step, so "the input pipeline
+  ate the speedup" is visible in one table;
+- **event timeline** — every subsystem event (checkpoint saves /
+  verify outcomes / guard escalations / GC / watchdog stalls /
+  comm-bucket estimates) with run-relative timestamps and per-kind
+  counts, interleaved with the step indices they landed between.
+
+Usage::
+
+    python tools/metrics_report.py run_metrics.jsonl
+    python tools/metrics_report.py run_metrics.jsonl --json out.json
+    python tools/metrics_report.py run_metrics.jsonl --bench BENCH_r05.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def load_records(path: str) -> List[dict]:
+    """Parse a metrics JSONL file; malformed lines (a crashed writer's
+    torn tail) are counted, not fatal."""
+    records, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    if bad:
+        print(f"note: skipped {bad} malformed line(s)", file=sys.stderr)
+    return records
+
+
+def _stats(xs: List[float], better=max) -> Dict[str, float]:
+    return {
+        "mean": sum(xs) / len(xs),
+        "best": better(xs),  # max for rates, min for ms/step
+        "final": xs[-1],
+    }
+
+
+def summarize(records: List[dict]) -> Dict[str, Any]:
+    """Aggregate one run's records into the report dict."""
+    steps = [r for r in records if r.get("kind") == "step"]
+    thr = [r for r in records if r.get("kind") == "throughput"]
+    meters = [r for r in records if r.get("kind") == "meters"]
+    events = [r for r in records if r.get("kind") == "event"]
+    t0 = min((r["t"] for r in records if "t" in r), default=0.0)
+
+    out: Dict[str, Any] = {
+        "runs": sorted({r["run"] for r in records if "run" in r}),
+        "n_records": len(records),
+    }
+
+    if steps:
+        scalar_keys = sorted(
+            k for k in steps[-1]
+            if k not in ("t", "kind", "step", "run")
+        )
+        out["steps"] = {
+            "count": len(steps),
+            "first": steps[0].get("step"),
+            "last": steps[-1].get("step"),
+        }
+        out["scalars"] = {}
+        for k in scalar_keys:
+            xs = [float(r[k]) for r in steps
+                  if isinstance(r.get(k), (int, float))]
+            if xs:
+                out["scalars"][k] = {
+                    "first": xs[0], "last": xs[-1],
+                    "min": min(xs), "max": max(xs),
+                }
+
+    if thr:
+        tps = [float(r["tokens_per_sec"]) for r in thr
+               if "tokens_per_sec" in r]
+        msps = [float(r["ms_per_step"]) for r in thr
+                if "ms_per_step" in r]
+        mfus = [float(r["mfu"]) for r in thr if "mfu" in r]
+        out["throughput"] = {
+            "windows": [
+                {k: (round(v, 4) if isinstance(v, float) else v)
+                 for k, v in r.items()
+                 if k in ("step", "ms_per_step", "tokens_per_sec", "mfu")}
+                for r in thr
+            ],
+        }
+        if tps:
+            # the BENCH_*.json-comparable headline (bench reports the
+            # best batch's steady-state rate; "best window" is the
+            # live-stream analog)
+            out["throughput"]["tokens_per_sec"] = _stats(tps)
+            out["metric"] = "run_tokens_per_sec"
+            out["value"] = round(max(tps), 1)
+            out["unit"] = "tokens/s"
+        if msps:
+            out["throughput"]["ms_per_step"] = _stats(msps, better=min)
+        if mfus:
+            out["throughput"]["mfu"] = _stats(mfus)
+
+    if meters:
+        final = meters[-1]
+        breakdown: Dict[str, Any] = {}
+        timings = final.get("timings_ms")
+        if timings and steps:
+            n = max(len(steps), 1)
+            breakdown["host_phase_ms_per_step"] = {
+                k: round(v / n, 4) for k, v in timings.items()
+            }
+        if final.get("counters"):
+            breakdown["counters"] = final["counters"]
+        if final.get("gauges"):
+            breakdown["gauges"] = final["gauges"]
+        if breakdown:
+            out["meters"] = breakdown
+
+    if events:
+        counts: Dict[str, int] = {}
+        timeline = []
+        for r in events:
+            kind = r.get("event", "?")
+            counts[kind] = counts.get(kind, 0) + 1
+            entry = {"t_rel_s": round(r.get("t", t0) - t0, 3),
+                     "event": kind}
+            for k in ("step", "path", "ok", "duration_s", "bytes",
+                      "restored_step", "consecutive_bad", "bucket",
+                      "elapsed_s", "error"):
+                if k in r:
+                    entry[k] = r[k]
+            timeline.append(entry)
+        out["events"] = {"counts": counts, "timeline": timeline}
+
+    return out
+
+
+def compare_to_bench(summary: Dict[str, Any], bench_path: str
+                     ) -> Optional[Dict[str, Any]]:
+    """Ratio of this run's headline tokens/s to a BENCH_*.json record's
+    (``{"metric": ..., "value": ..., "unit": "tokens/s"}``)."""
+    try:
+        with open(bench_path) as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read bench record {bench_path}: {e}",
+              file=sys.stderr)
+        return None
+    bval = bench.get("value")
+    if not bval or "value" not in summary:
+        return None
+    return {
+        "bench_metric": bench.get("metric"),
+        "bench_value": bval,
+        "run_value": summary["value"],
+        "run_vs_bench": round(summary["value"] / bval, 3),
+    }
+
+
+def format_report(summary: Dict[str, Any]) -> str:
+    lines = []
+    runs = ", ".join(summary.get("runs") or ["?"])
+    lines.append(f"== metrics report: {runs} "
+                 f"({summary.get('n_records', 0)} records) ==")
+    st = summary.get("steps")
+    if st:
+        lines.append(f"steps {st['first']}..{st['last']} "
+                     f"({st['count']} logged)")
+    for k, s in (summary.get("scalars") or {}).items():
+        lines.append(f"  {k}: first {s['first']:.4f}  last {s['last']:.4f}"
+                     f"  min {s['min']:.4f}  max {s['max']:.4f}")
+    thr = summary.get("throughput")
+    if thr:
+        lines.append("throughput trajectory (per flush window):")
+        for w in thr["windows"]:
+            row = f"  step {w.get('step')}: "
+            if "ms_per_step" in w:
+                row += f"{w['ms_per_step']:.2f} ms/step"
+            if "tokens_per_sec" in w:
+                row += f"  {w['tokens_per_sec']:,.0f} tokens/s"
+            if "mfu" in w:
+                row += f"  mfu {w['mfu']:.4f}"
+            lines.append(row)
+        for key in ("tokens_per_sec", "ms_per_step", "mfu"):
+            if key in thr:
+                s = thr[key]
+                lines.append(
+                    f"  {key}: mean {s['mean']:.4g}  best {s['best']:.4g}"
+                    f"  final {s['final']:.4g}")
+    met = summary.get("meters")
+    if met:
+        if "host_phase_ms_per_step" in met:
+            lines.append("host phase time (ms/step): " + "  ".join(
+                f"{k} {v:.3f}" for k, v in
+                met["host_phase_ms_per_step"].items()))
+        if "counters" in met:
+            lines.append("counters: " + "  ".join(
+                f"{k}={v}" for k, v in met["counters"].items()))
+    ev = summary.get("events")
+    if ev:
+        lines.append("events: " + "  ".join(
+            f"{k}x{v}" for k, v in sorted(ev["counts"].items())))
+        for e in ev["timeline"]:
+            extra = "  ".join(
+                f"{k}={e[k]}" for k in e if k not in ("t_rel_s", "event"))
+            lines.append(f"  +{e['t_rel_s']:9.3f}s  {e['event']}  {extra}")
+    cmp_ = summary.get("vs_bench")
+    if cmp_:
+        lines.append(
+            f"vs bench {cmp_['bench_metric']}: run {cmp_['run_value']:,} "
+            f"/ bench {cmp_['bench_value']:,} = {cmp_['run_vs_bench']}x")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("jsonl", help="metrics JSONL file (MetricsLogger "
+                                  "output)")
+    ap.add_argument("--json", default=None,
+                    help="also write the summary dict here")
+    ap.add_argument("--bench", default=None,
+                    help="a BENCH_*.json record to compare the "
+                         "headline tokens/s against")
+    args = ap.parse_args(argv)
+    records = load_records(args.jsonl)
+    if not records:
+        print(f"{args.jsonl}: no records", file=sys.stderr)
+        return 1
+    summary = summarize(records)
+    if args.bench:
+        cmp_ = compare_to_bench(summary, args.bench)
+        if cmp_:
+            summary["vs_bench"] = cmp_
+    print(format_report(summary))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1)
+        print(f"wrote {args.json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,6 +41,7 @@ CONFIGS = [
 
 def measure(tag, layers, hidden, heads, batch):
     from bench import FLAGSHIP, _peak_flops
+    from apex_tpu.telemetry.metrics import transformer_flops_per_token
     from tools.profile_r05 import build
 
     params, opt_state, step, n_params = build(
@@ -58,7 +59,10 @@ def measure(tag, layers, hidden, heads, batch):
     final = float(loss)
     dt = (time.perf_counter() - t0) / STEPS
     assert jnp.isfinite(final), f"{tag}: non-finite loss"
-    flops_per_token = 6 * n_params + 12 * layers * hidden * SEQ
+    # the shared model-FLOP estimate (6N + 12*L*h*s) — the same
+    # numerator the live telemetry's StepStats MFU uses
+    flops_per_token = transformer_flops_per_token(
+        n_params, layers, hidden, SEQ)
     tok_s = batch * SEQ / dt
     peak = _peak_flops(jax.devices()[0])
     mfu = tok_s * flops_per_token / peak if peak else None

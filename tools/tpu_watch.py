@@ -15,6 +15,20 @@ Loop:
   3. on failure: sleep ``--interval`` (default 420 s) and retry until
      ``--deadline-s`` (default 9 h), then exit 3
 
+``BENCH_WATCH.json`` record schema: ``{"captured": bool, "attempt":
+int, "bench_rc": int, "result": <the bench headline JSON line>}``,
+plus a transient ``"probe_failure"`` entry bench.py parks for its
+same-boot probe cache.  The bench extras that ride a capture into
+``BENCH_EXTRA.json`` now also carry the ``telemetry_overhead`` row
+(``--child telemetry``: flagship-CPU-dryrun-shape ms/step with metrics
+on vs off, ``vs_baseline`` null per the CPU convention).
+
+While waiting on the chip pool, each probe attempt also reports the
+training job's watchdog heartbeat (``$APEX_TPU_HEARTBEAT_FILE``,
+written by ``apex_tpu.resilience.Watchdog.beat``) when one exists, so
+"the trainer is alive but the pool is wedged" and "the trainer died"
+are distinguishable from this log alone.
+
 A lock file (``/tmp/apex_tpu_watch.lock``) guards against two TPU
 clients contending for the one claim; anything else that wants the chip
 must check it.  Exit codes: 0 captured, 3 deadline, 4 lock held.
@@ -35,6 +49,26 @@ PY = sys.executable
 
 def log(*a):
     print(f"[tpu_watch {time.strftime('%H:%M:%S')}]", *a, flush=True)
+
+
+def heartbeat_note():
+    """One log fragment describing the training job's liveness, read
+    from the watchdog heartbeat file ($APEX_TPU_HEARTBEAT_FILE); empty
+    when no heartbeat is configured/readable.  Kept dependency-light:
+    the reader mirrors apex_tpu.resilience.watchdog.read_heartbeat
+    without importing jax into this daemon."""
+    path = os.environ.get("APEX_TPU_HEARTBEAT_FILE")
+    if not path:
+        return ""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        age = time.time() - float(rec["at"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return ""
+    step = rec.get("step")
+    where = f" at step {step}" if step is not None else ""
+    return f" | trainer heartbeat {age:.0f}s ago{where}"
 
 
 _current_proc = None
@@ -220,7 +254,8 @@ def main():
                 log(f"bench ran but no TPU result (rc={rc}); continuing")
             else:
                 log(f"attempt {attempt}: no chip "
-                    f"({(time.time() - t0) / 60:.0f} min elapsed)")
+                    f"({(time.time() - t0) / 60:.0f} min elapsed)"
+                    + heartbeat_note())
             time.sleep(interval)
         log("deadline reached without capture")
         return 3
