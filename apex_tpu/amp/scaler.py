@@ -112,6 +112,14 @@ class LossScaler:
         """``loss.float() * loss_scale`` (reference: apex/amp/handle.py:113)."""
         return loss.astype(jnp.float32) * state.loss_scale
 
+    def inv_scale(self, state: ScalerState) -> jnp.ndarray:
+        """``1 / loss_scale`` — the multiplier
+        :meth:`~apex_tpu.optimizers.base.FusedOptimizer.step_scaled`
+        folds into the fused optimizer tail's single gradient read
+        (this scaler's :meth:`unscale` then never runs as its own
+        pass; ``adjust`` still consumes the returned finite flag)."""
+        return 1.0 / state.loss_scale
+
     def unscale(self, state: ScalerState, grads: Any) -> Tuple[Any, jnp.ndarray]:
         """Unscale grads by 1/scale; also report whether they are all finite.
 

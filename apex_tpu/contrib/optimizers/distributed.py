@@ -158,9 +158,12 @@ class _DistributedOptimizer:
         self.axis_name = axis_name
         # opt-in int8 quantization of the DCN leg of the hierarchical
         # gradient reduce (the lax.psum of the 1/ici reduce-scattered
-        # shard across dcn) — the ici RS/AG legs, the fp32 masters and
-        # the param all-gather are untouched.  Error feedback (config
-        # default) rides the optimizer state as state["comm"]
+        # shard across dcn) — by default the ici RS leg, the fp32
+        # masters and the param all-gather are untouched; with
+        # CompressionConfig(ici_legs=True) the grad RS over ici also
+        # goes int8 (the param gather stays governed by
+        # compressed_allgather).  Error feedback (config default)
+        # rides the optimizer state as state["comm"]
         self.compression = as_compression_config(compression)
         if self.compression is not None and not isinstance(
             axis_name, (tuple, list)
@@ -285,11 +288,17 @@ class _DistributedOptimizer:
         if (self.compression is not None
                 and self.compression.error_feedback):
             # quantization residuals vary over BOTH data axes: each
-            # (dcn, ici) position compensates its own rounding error
+            # (dcn, ici) position compensates its own rounding error.
+            # ici_legs adds the RS leg's residual (the grad all-gather
+            # has no analog here — ZeRO gathers PARAMS, covered by
+            # compressed_allgather)
             cax = ((*model_axes, self._cross_axis, self._shard_axis)
                    if model_axes
                    else (self._cross_axis, self._shard_axis))
-            specs["comm"] = {"push": P(cax), "pull": P(cax)}
+            keys = ["push", "pull"]
+            if self.compression.ici_legs:
+                keys.append("ici_push")
+            specs["comm"] = {k: P(cax) for k in keys}
         if self._mask is not None:
             # data-axis-sharded leaves keep the PARAM's own spec: their
             # state lives exactly where the shard lives.  NOTE the spec
@@ -331,6 +340,12 @@ class _DistributedOptimizer:
                 meta.shard, _axis_size(self._cross_axis),
                 self.compression.block_size,
             )
+            if self.compression.ici_legs:
+                # compensates the quantized grad reduce-scatter of the
+                # full local flat buffer (one row per ici peer)
+                state["comm"]["ici_push"] = jnp.zeros(
+                    (meta.padded,), jnp.float32
+                )
         if local_tree is not None:
             f32_tree = jax.tree.map(
                 lambda x: jnp.asarray(x, jnp.float32), local_tree)
@@ -381,21 +396,56 @@ class _DistributedOptimizer:
         # mean-reduce-scatter: each rank receives its shard of the
         # dp-summed gradient.  Hierarchical: RS within ici, then AR of
         # the 1/ici shard across dcn (reference's 2-level pattern) —
-        # optionally int8-quantized, the only lossy leg when
-        # ``compression`` is set
-        g_local = lax.psum_scatter(
-            flat_grads, self._shard_axis, tiled=True
-        )
+        # optionally int8-quantized (``compression``; with ici_legs
+        # the RS itself goes int8 too, chunk boundaries preserved so
+        # the flat master layout is untouched)
+        comm = state.get("comm")
+        ici_legs = (self.compression is not None
+                    and self.compression.ici_legs
+                    and self._cross_axis is not None)
+        # one base dither key per step, decorrelated per LEG: feeding
+        # both quantization sites only step= would re-derive the SAME
+        # key wherever a device's ici and dcn coordinates coincide
+        # (the hazard _hierarchical_psum's leg_key fixes)
+        rs_key = dcn_key = None
+        if (ici_legs and self.compression.rounding == "stochastic"):
+            base = jax.random.fold_in(jax.random.PRNGKey(0),
+                                      state["step"])
+            dcn_key = jax.random.fold_in(base, 0)
+            rs_key = jax.random.fold_in(base, 1)
+        new_ici_push = None
+        if ici_legs:
+            from apex_tpu.ops.quantization import (
+                quantized_reduce_scatter,
+            )
+
+            g_local, new_ici_push = quantized_reduce_scatter(
+                flat_grads, self._shard_axis, self.compression,
+                residual=None if comm is None else comm["ici_push"],
+                step=state["step"], key=rs_key,
+            )
+        else:
+            g_local = lax.psum_scatter(
+                flat_grads, self._shard_axis, tiled=True
+            )
         total = world
         new_comm = None
         if self._cross_axis is not None:
             if self.compression is not None:
                 from apex_tpu.ops.quantization import quantized_psum
 
+                dcn_residual = None
+                if comm is not None:
+                    dcn_residual = {"push": comm["push"],
+                                    "pull": comm["pull"]}
                 g_local, new_comm = quantized_psum(
                     g_local, self._cross_axis, self.compression,
-                    residual=state.get("comm"), step=state["step"],
+                    residual=dcn_residual, step=state["step"],
+                    key=dcn_key,
                 )
+                if new_comm is not None and new_ici_push is not None:
+                    new_comm = dict(new_comm)
+                    new_comm["ici_push"] = new_ici_push
             else:
                 g_local = lax.psum(g_local, self._cross_axis)
             total = world * _axis_size(self._cross_axis)

@@ -25,7 +25,17 @@ This module is the numeric core plus the one compressed collective:
   int8 x fp32-scale products, re-quantize the reduced shard once, and
   ``all_gather`` int8 + scales back.  Only the tiny fp32 scale
   sidecar (``4 / block_size`` bytes per element) crosses the axis at
-  full width, so bytes-on-wire drop ~4x vs an fp32 psum.
+  full width, so bytes-on-wire drop ~4x vs an fp32 psum;
+- :func:`quantized_reduce_scatter` / :func:`quantized_all_gather` —
+  the EQuARX ICI half: the same int8-values + fp32-scales wire format
+  applied to ONE leg each, chunk-preserving (rank *r* receives exactly
+  the elements ``lax.psum_scatter(tiled)`` would give it, for any
+  chunk size — blocks never straddle row boundaries, so enabling
+  compression never moves a shard boundary).  ``CompressionConfig(
+  ici_legs=True)`` makes the hierarchical reduce run BOTH its ICI
+  legs through these (see ``_hierarchical_psum``), with their own
+  error-feedback residuals (``ici_push`` / ``ici_pull``) beside the
+  DCN pair.
 
 Deviation from the ISSUE's "(int32-accumulated values, scales)"
 sketch: each sender keeps its OWN per-block scales (no extra
@@ -52,9 +62,14 @@ __all__ = [
     "as_compression_config",
     "quantize_blockwise",
     "dequantize_blockwise",
+    "quantize_rows",
+    "dequantize_rows",
     "comm_residual_sizes",
+    "hierarchical_residual_sizes",
     "init_residual",
     "quantized_psum",
+    "quantized_reduce_scatter",
+    "quantized_all_gather",
 ]
 
 _INT8_MAX = 127.0
@@ -72,12 +87,20 @@ class CompressionConfig:
     ``error_feedback``: carry the per-device quantization residual as
     explicit state and add it back next step (strongly recommended for
     training; requires the caller to thread a state pytree).
+    ``ici_legs``: ALSO compress the reduce-scatter/all-gather legs of
+    the hierarchical reduce (EQuARX's ICI half) — default off, which
+    leaves those legs full-width exactly as before; with error
+    feedback the residual state then carries two extra buffers
+    (``ici_push``/``ici_pull``) and must be rebuilt with the same
+    config (:func:`~apex_tpu.parallel.distributed.init_comm_state`
+    sizes them from the config automatically).
     """
 
     method: str = "int8"
     block_size: int = 256
     rounding: str = "nearest"
     error_feedback: bool = True
+    ici_legs: bool = False
 
     def __post_init__(self):
         if self.method != "int8":
@@ -175,6 +198,54 @@ def dequantize_blockwise(
     )
 
 
+def quantize_rows(
+    x: jnp.ndarray,
+    block_size: int = 256,
+    rounding: str = "nearest",
+    key: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-ROW block-wise quantize of a 2-D ``(rows, n)`` array: blocks
+    never straddle row boundaries, so each row can be exchanged (and
+    dequantized) independently of its neighbours — the property the
+    chunk-preserving RS/AG legs need.  Same per-block math as
+    :func:`quantize_blockwise`; a single row is bit-identical to it.
+    Returns ``(values int8 (rows, n), scales fp32 (rows,
+    ceil(n/block_size)))``."""
+    rows, n = x.shape
+    nb = max(-(-n // block_size), 1)
+    pad = nb * block_size - n
+    xf = x.astype(jnp.float32)
+    if pad:
+        xf = jnp.concatenate(
+            [xf, jnp.zeros((rows, pad), jnp.float32)], axis=1
+        )
+    xb = xf.reshape(rows, nb, block_size)
+    amax = jnp.max(jnp.abs(xb), axis=2)
+    scales = jnp.where(amax > 0.0, amax / _INT8_MAX, 1.0)
+    v = jnp.clip(xb / scales[:, :, None], -_INT8_MAX, _INT8_MAX)
+    if rounding == "stochastic":
+        if key is None:
+            raise ValueError("stochastic rounding requires a PRNG key")
+        u = jax.random.uniform(key, v.shape, jnp.float32)
+        q = jnp.floor(v + u)
+    else:
+        q = jnp.round(v)
+    q = jnp.clip(q, -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q.reshape(rows, nb * block_size)[:, :n], scales
+
+
+def dequantize_rows(
+    values: jnp.ndarray,
+    scales: jnp.ndarray,
+    block_size: int = 256,
+    dtype: Any = jnp.float32,
+) -> jnp.ndarray:
+    """Inverse of :func:`quantize_rows` (up to rounding error)."""
+    rows, n = values.shape
+    expand = jnp.repeat(scales, block_size, axis=1)[:, :n]
+    return (values.astype(jnp.float32) * expand).astype(dtype)
+
+
 def comm_residual_sizes(
     n: int, world: int, block_size: int
 ) -> Tuple[int, int]:
@@ -185,6 +256,27 @@ def comm_residual_sizes(
     residual the re-quantized reduced shard this rank owns."""
     padded = n + (-n) % (world * block_size)
     return padded, padded // world
+
+
+def hierarchical_residual_sizes(
+    n: int, dcn: int, ici: int, block_size: int, ici_legs: bool = False
+) -> dict:
+    """Per-device error-feedback buffer lengths for ONE leaf of ``n``
+    local elements through the hierarchical RS(ici) → AR(dcn) →
+    AG(ici) reduce: ``push``/``pull`` compensate the DCN all-reduce's
+    two quantization events (unchanged from the DCN-only design), and
+    — with ``ici_legs`` — ``ici_push`` covers the full ici-padded
+    local buffer quantized before the reduce-scatter while
+    ``ici_pull`` covers the owned chunk quantized before the
+    all-gather.  The ONE sizing shared by ``init_comm_state``,
+    ``bucket_comm_state`` and the trace-time validation."""
+    chunk = (n + (-n) % ici) // ici
+    padded, shard = comm_residual_sizes(chunk, dcn, block_size)
+    sizes = {"push": padded, "pull": shard}
+    if ici_legs:
+        sizes["ici_push"] = ici * chunk
+        sizes["ici_pull"] = chunk
+    return sizes
 
 
 def init_residual(
@@ -306,3 +398,97 @@ def quantized_psum(
     gs = all_gather_invariant(s2, axis_name, axis=0, tiled=True)
     out = dequantize_blockwise(gq, gs, block)[:n]
     return out.reshape(shape).astype(dtype), new_residual
+
+
+def quantized_reduce_scatter(
+    x: jnp.ndarray,
+    axis_name,
+    compression: Union[str, CompressionConfig] = "int8",
+    residual: Optional[jnp.ndarray] = None,
+    key: Optional[jnp.ndarray] = None,
+    step: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Approximate ``lax.psum_scatter(x, axis_name, tiled=True)`` with
+    int8 bytes on wire — the EQuARX ICI reduce-scatter leg.
+
+    ``x`` is a flat ``(n,)`` fp32 array with ``n % world == 0``
+    (callers pad to the ici extent exactly as the uncompressed path
+    does).  Chunk boundaries are PRESERVED: rank *r* receives the sum
+    of every rank's elements ``[r*n/world, (r+1)*n/world)`` — the
+    per-row quantization (:func:`quantize_rows`) keeps blocks inside
+    row boundaries for any chunk size, so turning compression on never
+    moves a shard.  Each sender quantizes its whole (local) buffer
+    once, ``all_to_all``s int8 values + fp32 scales, and the receiver
+    accumulates exact ``int8 x fp32-scale`` products.
+
+    ``residual`` is the flat ``(n,)`` ``ici_push`` error-feedback
+    buffer (added before quantizing; the fresh rounding error comes
+    back as ``new_residual``).  Returns ``(chunk (n/world,),
+    new_residual_or_None)``."""
+    cfg = as_compression_config(compression)
+    world = _axis_size(axis_name)
+    n = int(jnp.size(x))
+    if n % world:
+        raise ValueError(
+            f"quantized_reduce_scatter needs size % world == 0 "
+            f"(got {n} over {world}): pad like the uncompressed path"
+        )
+    shard = n // world
+    flat = x.reshape(-1).astype(jnp.float32)
+    rkey = _rounding_key(cfg, axis_name, key, step)
+    if residual is not None:
+        flat = flat + residual
+    q, s = quantize_rows(
+        flat.reshape(world, shard), cfg.block_size, cfg.rounding, rkey
+    )
+    new_residual = None
+    if residual is not None:
+        new_residual = flat - dequantize_rows(
+            q, s, cfg.block_size
+        ).reshape(-1)
+    qt = jax.lax.all_to_all(q, axis_name, 0, 0)
+    st = jax.lax.all_to_all(s, axis_name, 0, 0)
+    chunk = jnp.sum(dequantize_rows(qt, st, cfg.block_size), axis=0)
+    return chunk, new_residual
+
+
+def quantized_all_gather(
+    x: jnp.ndarray,
+    axis_name,
+    compression: Union[str, CompressionConfig] = "int8",
+    residual: Optional[jnp.ndarray] = None,
+    key: Optional[jnp.ndarray] = None,
+    step: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Approximate a tiled ``all_gather(x, axis_name)`` with int8 bytes
+    on wire — the EQuARX ICI all-gather leg.
+
+    Each rank quantizes its ``(shard,)`` chunk once and gathers int8
+    values + fp32 scales; every rank dequantizes the identical gathered
+    bytes, so the result is replicated over the axis (invariant-typed,
+    like the uncompressed ``all_gather_invariant`` it replaces).
+    ``residual`` is the ``(shard,)`` ``ici_pull`` error-feedback
+    buffer.  Returns ``(full (world*shard,), new_residual_or_None)``."""
+    cfg = as_compression_config(compression)
+    world = _axis_size(axis_name)
+    shard = int(jnp.size(x))
+    flat = x.reshape(-1).astype(jnp.float32)
+    rkey = _rounding_key(cfg, axis_name, key, step)
+    if residual is not None:
+        flat = flat + residual
+    q, s = quantize_blockwise(flat, cfg.block_size, cfg.rounding, rkey)
+    new_residual = None
+    if residual is not None:
+        new_residual = flat - dequantize_blockwise(q, s, cfg.block_size)
+
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        all_gather_invariant,
+    )
+
+    gq = all_gather_invariant(q, axis_name, axis=0, tiled=True)
+    gs = all_gather_invariant(s, axis_name, axis=0, tiled=True)
+    nb = int(s.shape[0])
+    out = dequantize_rows(
+        gq.reshape(world, shard), gs.reshape(world, nb), cfg.block_size
+    ).reshape(-1)
+    return out, new_residual

@@ -49,11 +49,25 @@ class FusedOptimizer:
     ``master_weights=True`` keeps an fp32 master in the optimizer state;
     ``step`` then updates the master and returns model-dtype params cast
     from it, so the training loop never touches fp32 copies itself.
+
+    ``fused_tail=True`` (FusedAdam/FusedLAMB) switches the state layout
+    to packed per-bucket flat fp32 buffers and runs the whole
+    unscale → clip → moment update → cast chain as ONE multi-tensor
+    pass per buffer (:mod:`apex_tpu.optimizers.fused_tail`) —
+    bit-identical numerics at default settings, one read and one write
+    of params/grads/moments per step instead of the per-leaf chain's
+    several.  ``bucket_bytes`` sizes the buffers (the PR 4 plan
+    default).  Combine with :meth:`step_scaled` to fold the amp
+    scaler's unscale + finiteness check into the same gradient read.
     """
 
-    def __init__(self, lr: float = 1e-3, master_weights: bool = False):
+    def __init__(self, lr: float = 1e-3, master_weights: bool = False,
+                 fused_tail: bool = False,
+                 bucket_bytes: Optional[int] = None):
         self.lr = lr
         self.master_weights = master_weights
+        self.fused_tail = fused_tail
+        self.bucket_bytes = bucket_bytes
 
     # -- to be provided by subclasses -----------------------------------
     def _init_extra(self, params: Any) -> dict:
@@ -64,8 +78,45 @@ class FusedOptimizer:
         """Returns (new_params_f32, new_extra).  ``params`` arrive fp32."""
         raise NotImplementedError
 
+    # -- fused-tail hooks (FusedAdam / FusedLAMB) ------------------------
+    def _tail_state_dtypes(self) -> Optional[dict]:
+        """{state key: storage dtype} of the packed buffers, or None
+        when the optimizer has no fused-tail implementation."""
+        return None
+
+    def _tail_update(self, extra: dict, step: jnp.ndarray, g_views,
+                     p_views, lr: jnp.ndarray, ctx) -> tuple:
+        """The fused-tail analog of ``_update``: ``g_views``/
+        ``p_views`` and every ``extra`` entry are per-LEAF fp32 lists
+        (flatten order; state views sliced out of the packed buffers
+        by ``ctx`` — a :class:`~apex_tpu.optimizers.fused_tail.
+        TailContext`), and the math must run in the leaf shapes so the
+        bits match the per-leaf chain.  Returns ``(new_p_views,
+        new_extra_views)``."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement the fused tail"
+        )
+
+    def _require_tail(self) -> None:
+        if self._tail_state_dtypes() is None:
+            raise ValueError(
+                f"fused_tail=True is not supported by "
+                f"{type(self).__name__} (only FusedAdam / FusedLAMB "
+                "implement the multi-tensor tail pass)"
+            )
+
+    def _tail_plan(self, params: Any):
+        from apex_tpu.optimizers.fused_tail import (
+            DEFAULT_BUCKET_BYTES,
+            tail_plan,
+        )
+
+        return tail_plan(params, self.bucket_bytes or DEFAULT_BUCKET_BYTES)
+
     # -- public API ------------------------------------------------------
     def init(self, params: Any) -> dict:
+        if self.fused_tail:
+            return self._init_fused(params)
         state = {"step": jnp.int32(0)}
         state.update(self._init_extra(params))
         if self.master_weights:
@@ -76,6 +127,44 @@ class FusedOptimizer:
                 lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params
             )
         return state
+
+    def _init_fused(self, params: Any) -> dict:
+        from apex_tpu.optimizers.fused_tail import pack_tree
+
+        self._require_tail()
+        plan = self._tail_plan(params)
+        state: dict = {"step": jnp.int32(0)}
+        for key, dtype in self._tail_state_dtypes().items():
+            state[key] = {
+                name: jnp.zeros((b.size,), dtype)
+                for name, b in zip(plan.names, plan.buckets)
+            }
+        if self.master_weights:
+            state["master"] = pack_tree(plan, jax.tree.leaves(params))
+        return state
+
+    def unpack_state(self, state: dict, params: Any) -> dict:
+        """Per-leaf view of a fused-tail state (moments/master shaped
+        like ``params``) — for tests, debugging and migrating a packed
+        checkpoint back to the per-leaf layout.  Per-leaf states pass
+        through unchanged."""
+        if not self.fused_tail:
+            return state
+        from apex_tpu.optimizers.fused_tail import unpack_bufs
+
+        plan = self._tail_plan(params)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        f32_like = [jnp.zeros(jnp.shape(l), jnp.float32) for l in leaves]
+        out = {"step": state["step"]}
+        for key in self._tail_state_dtypes():
+            out[key] = jax.tree_util.tree_unflatten(
+                treedef, unpack_bufs(plan, state[key], f32_like)
+            )
+        if self.master_weights:
+            out["master"] = jax.tree_util.tree_unflatten(
+                treedef, unpack_bufs(plan, state["master"], f32_like)
+            )
+        return out
 
     def step(
         self,
@@ -93,6 +182,11 @@ class FusedOptimizer:
         ``_master_params_to_model_params``
         (reference: apex/amp/_process_optimizer.py:14).
         """
+        if self.fused_tail:
+            new_params, new_state, _ = self._step_fused(
+                state, grads, params, lr=lr, grads_finite=grads_finite
+            )
+            return new_params, new_state
         lr = f32(self.lr if lr is None else lr)
         new_step = state["step"] + 1
         work_params = state["master"] if self.master_weights else jax.tree.map(
@@ -114,6 +208,114 @@ class FusedOptimizer:
             new_params = tree_where(grads_finite, new_params, params)
             new_state = tree_where(grads_finite, new_state, state)
         return new_params, new_state
+
+    def step_scaled(
+        self,
+        state: dict,
+        grads: Any,
+        params: Any,
+        inv_scale: jnp.ndarray,
+        lr: Optional[jnp.ndarray] = None,
+        finite_reduce: Optional[Callable] = None,
+    ) -> tuple:
+        """The whole amp tail in one call: unscale by ``inv_scale``
+        (= ``scaler.inv_scale(scaler_state)``), finiteness check,
+        optimizer update with the overflow no-op — returning
+        ``(new_params, new_state, grads_finite)`` so the caller feeds
+        ``grads_finite`` to ``scaler.adjust``.
+
+        With ``fused_tail`` the unscale and the finiteness reduction
+        fold into the single packed-gradient read (no separate
+        ``scale_gradients`` pass); without it this is exactly the seed
+        ``scaler.unscale`` → ``step`` chain, bit for bit.
+        ``finite_reduce`` hooks a cross-device consensus (e.g.
+        ``model_parallel_all_finite``) between the local check and the
+        skip decision."""
+        if self.fused_tail:
+            return self._step_fused(
+                state, grads, params, lr=lr, inv_scale=inv_scale,
+                finite_reduce=finite_reduce,
+            )
+        from apex_tpu.amp.scaler import all_finite, scale_gradients
+
+        finite = all_finite(grads)
+        if finite_reduce is not None:
+            finite = finite_reduce(finite)
+        grads = scale_gradients(grads, inv_scale)
+        new_params, new_state = self.step(
+            state, grads, params, lr=lr, grads_finite=finite
+        )
+        return new_params, new_state, finite
+
+    def _step_fused(
+        self,
+        state: dict,
+        grads: Any,
+        params: Any,
+        lr: Optional[jnp.ndarray] = None,
+        grads_finite: Optional[jnp.ndarray] = None,
+        inv_scale: Optional[jnp.ndarray] = None,
+        finite_reduce: Optional[Callable] = None,
+    ) -> tuple:
+        """One multi-tensor pass over the packed buffers (see
+        :mod:`apex_tpu.optimizers.fused_tail`)."""
+        from apex_tpu.optimizers.fused_tail import (
+            TailContext,
+            emit_opt_tail_event,
+            fold_grads,
+        )
+        from apex_tpu.telemetry.spans import phase as _phase
+
+        self._require_tail()
+        lr = f32(self.lr if lr is None else lr)
+        plan = self._tail_plan(params)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        g_leaves = treedef.flatten_up_to(grads)
+        ctx = TailContext(plan, tuple(jnp.shape(l) for l in leaves))
+        emit_opt_tail_event(self, plan,
+                            unscale_folded=inv_scale is not None)
+        with _phase("optimizer"):
+            # ONE read of the gradients, with the scaler's unscale and
+            # the finiteness reduction folded in
+            g_views, local_finite = fold_grads(g_leaves, inv_scale)
+            if inv_scale is not None:
+                finite = local_finite
+                if finite_reduce is not None:
+                    finite = finite_reduce(finite)
+            else:
+                finite = grads_finite
+            new_step = state["step"] + 1
+            if self.master_weights:
+                p_views = ctx.views(state["master"])
+            else:
+                p_views = [jnp.asarray(l).astype(jnp.float32)
+                           for l in leaves]
+            dtypes = self._tail_state_dtypes()
+            extra = {
+                k: ctx.views({n: state[k][n].astype(jnp.float32)
+                              for n in plan.names})
+                for k in dtypes
+            }
+            new_p_views, new_extra = self._tail_update(
+                extra, new_step, g_views, p_views, lr, ctx
+            )
+            # the one write of the packed state: XLA fuses the
+            # concatenate into each buffer's output loop
+            new_state: dict = {"step": new_step}
+            for k, dt in dtypes.items():
+                new_state[k] = ctx.pack_views(new_extra[k], dtype=dt)
+            if self.master_weights:
+                new_state["master"] = ctx.pack_views(new_p_views)
+            # ... and the one write of model-dtype params
+            new_params = jax.tree_util.tree_unflatten(
+                treedef,
+                [v.astype(jnp.asarray(l).dtype)
+                 for v, l in zip(new_p_views, leaves)],
+            )
+            if finite is not None:
+                new_params = tree_where(finite, new_params, params)
+                new_state = tree_where(finite, new_state, state)
+        return new_params, new_state, finite
 
     # -- optax interop ---------------------------------------------------
     def as_optax(self):

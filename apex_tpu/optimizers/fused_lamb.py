@@ -11,11 +11,20 @@ csrc/multi_tensor_lamb.cu):
 4. per-parameter trust ratio ``||p|| / ||update||`` applied to the lr,
    with the NVLAMB variant (``use_nvlamb=True``) also applying the ratio
    to parameters excluded from weight decay.
+
+``fused_tail=True`` runs the whole chain as one multi-tensor pass over
+packed buffers (per-parameter norms reduce over per-leaf VIEWS of the
+buffers in the leaf shapes, so the trust ratios match the per-leaf
+chain — bit-identically except with ``master_weights``, where some CPU
+backends contract the norm's square-accumulate over a buffer view to
+FMA differently than over a standalone array, a test-bounded 1-ulp
+wobble); ``exp_avg_sq_dtype`` is the opt-in sub-fp32 second-moment
+storage (see fused_adam.py / docs/optimizers.md).
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -40,10 +49,14 @@ class FusedLAMB(FusedOptimizer):
         max_grad_norm: float = 1.0,
         use_nvlamb: bool = False,
         master_weights: bool = False,
+        fused_tail: bool = False,
+        bucket_bytes: Optional[int] = None,
+        exp_avg_sq_dtype: Any = jnp.float32,
     ):
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
-        super().__init__(lr=lr, master_weights=master_weights)
+        super().__init__(lr=lr, master_weights=master_weights,
+                         fused_tail=fused_tail, bucket_bytes=bucket_bytes)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -52,15 +65,23 @@ class FusedLAMB(FusedOptimizer):
         self.grad_averaging = grad_averaging
         self.max_grad_norm = max_grad_norm
         self.use_nvlamb = use_nvlamb
+        self.exp_avg_sq_dtype = jnp.dtype(exp_avg_sq_dtype)
+        if not jnp.issubdtype(self.exp_avg_sq_dtype, jnp.floating):
+            raise ValueError(
+                f"exp_avg_sq_dtype must be floating, got "
+                f"{self.exp_avg_sq_dtype}"
+            )
 
     def _init_extra(self, params: Any) -> dict:
-        zeros = lambda p: jnp.zeros(jnp.shape(p), jnp.float32)
         return {
-            "exp_avg": jax.tree.map(zeros, params),
-            "exp_avg_sq": jax.tree.map(zeros, params),
+            "exp_avg": jax.tree.map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params),
+            "exp_avg_sq": jax.tree.map(
+                lambda p: jnp.zeros(jnp.shape(p), self.exp_avg_sq_dtype),
+                params),
         }
 
-    def _update(self, extra, step, grads, params, lr):
+    def _coeffs(self, step):
         b1, b2 = f32(self.beta1), f32(self.beta2)
         beta3 = 1.0 - b1 if self.grad_averaging else jnp.float32(1.0)
         stepf = step.astype(jnp.float32)
@@ -69,40 +90,57 @@ class FusedLAMB(FusedOptimizer):
             bc2 = 1.0 - b2 ** stepf
         else:
             bc1 = bc2 = jnp.float32(1.0)
-        wd = f32(self.weight_decay)
+        return b1, b2, beta3, bc1, bc2, f32(self.weight_decay)
+
+    def _clip_factor(self, gnorm):
+        if self.max_grad_norm is not None and self.max_grad_norm > 0:
+            return jnp.where(
+                gnorm > self.max_grad_norm, self.max_grad_norm / gnorm, 1.0
+            )
+        return jnp.float32(1.0)
+
+    def _moments_and_update(self, g, p, m, v, coeffs):
+        """Stages 2-3 (+decay folds) — the ONE elementwise formula both
+        the per-leaf and fused-tail paths run; the trust ratio applies
+        outside (it needs per-parameter norms of `update`)."""
+        b1, b2, beta3, bc1, bc2, wd = coeffs
+        if not self.adam_w_mode and self.weight_decay != 0.0:
+            # MOMENT_MODE_0 (classic/L2): decay folds into the gradient
+            # *before* the moment updates (multi_tensor_lamb.cu).
+            g = g + wd * p
+        m = b1 * m + beta3 * g
+        v = b2 * v + (1.0 - b2) * jnp.square(g)
+        m_hat = m / bc1
+        v_hat = v / bc2
+        update = m_hat / (jnp.sqrt(v_hat) + self.eps)
+        if self.adam_w_mode and self.weight_decay != 0.0:
+            # MOMENT_MODE_1 (AdamW): decoupled decay on the update.
+            update = update + wd * p
+        return update, m, v
+
+    def _trust(self, w_norm, u_norm):
+        if self.weight_decay == 0.0 and not self.use_nvlamb:
+            # reference: trust ratio only on decayed params unless nvlamb
+            return jnp.ones_like(w_norm) if jnp.ndim(w_norm) \
+                else jnp.float32(1.0)
+        apply_trust = (w_norm > 0) & (u_norm > 0)
+        return jnp.where(apply_trust, w_norm / u_norm, 1.0)
+
+    def _update(self, extra, step, grads, params, lr):
+        coeffs = self._coeffs(step)
 
         # stage 0: global grad norm + clip (reference multi_tensor_l2norm
         # followed by the in-kernel clip in multi_tensor_lamb.cu)
-        gnorm = global_l2norm(grads)
-        if self.max_grad_norm is not None and self.max_grad_norm > 0:
-            clip = jnp.where(
-                gnorm > self.max_grad_norm, self.max_grad_norm / gnorm, 1.0
-            )
-        else:
-            clip = jnp.float32(1.0)
+        clip = self._clip_factor(global_l2norm(grads))
 
         def upd(p, g, m, v):
             g = g * clip
-            if not self.adam_w_mode and self.weight_decay != 0.0:
-                # MOMENT_MODE_0 (classic/L2): decay folds into the gradient
-                # *before* the moment updates (multi_tensor_lamb.cu).
-                g = g + wd * p
-            m = b1 * m + beta3 * g
-            v = b2 * v + (1.0 - b2) * jnp.square(g)
-            m_hat = m / bc1
-            v_hat = v / bc2
-            update = m_hat / (jnp.sqrt(v_hat) + self.eps)
-            if self.adam_w_mode and self.weight_decay != 0.0:
-                # MOMENT_MODE_1 (AdamW): decoupled decay on the update.
-                update = update + wd * p
+            update, m, v = self._moments_and_update(
+                g, p, m, v.astype(jnp.float32), coeffs
+            )
             w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
             u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
-            apply_trust = (w_norm > 0) & (u_norm > 0)
-            if self.weight_decay == 0.0 and not self.use_nvlamb:
-                # reference: trust ratio only on decayed params unless nvlamb
-                trust = jnp.float32(1.0)
-            else:
-                trust = jnp.where(apply_trust, w_norm / u_norm, 1.0)
+            trust = self._trust(w_norm, u_norm)
             return p - lr * trust * update, m, v
 
         out = jax.tree.map(upd, params, grads, extra["exp_avg"], extra["exp_avg_sq"])
@@ -110,5 +148,32 @@ class FusedLAMB(FusedOptimizer):
         flat = jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, tuple))
         new_p = jax.tree.unflatten(treedef, [t[0] for t in flat])
         new_m = jax.tree.unflatten(treedef, [t[1] for t in flat])
-        new_v = jax.tree.unflatten(treedef, [t[2] for t in flat])
+        new_v = jax.tree.unflatten(
+            treedef,
+            [t[2].astype(self.exp_avg_sq_dtype) for t in flat],
+        )
+        return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}
+
+    # ----------------------------------------------------- fused tail
+    def _tail_state_dtypes(self) -> dict:
+        return {"exp_avg": jnp.float32,
+                "exp_avg_sq": self.exp_avg_sq_dtype}
+
+    def _tail_update(self, extra, step, g_views, p_views, lr, ctx):
+        coeffs = self._coeffs(step)
+        clip = self._clip_factor(ctx.global_norm(g_views))
+        new_p, new_m, new_v = [], [], []
+        for g, p, m, v in zip(g_views, p_views, extra["exp_avg"],
+                              extra["exp_avg_sq"]):
+            update, nm, nv = self._moments_and_update(
+                g * clip, p, m, v, coeffs
+            )
+            # per-parameter trust ratio in the leaf's own shape — the
+            # exact per-leaf chain, so the norms (and every bit) match
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+            u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+            trust = self._trust(w_norm, u_norm)
+            new_p.append(p - lr * trust * update)
+            new_m.append(nm)
+            new_v.append(nv)
         return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}

@@ -35,15 +35,19 @@ What survives as *semantics* are the knobs, reproduced here exactly:
 reference: distributed.py:463-476), and ``allreduce_always_fp32``.
 
 Compressed collectives: with a hierarchical ``(dcn_axis, ici_axis)``
-axis pair, ``compression="int8"`` block-quantizes ONLY the DCN leg of
+axis pair, ``compression="int8"`` block-quantizes the DCN leg of
 the reduce (:mod:`apex_tpu.ops.quantization`): the ici-reduced chunk is
 quantized once, exchanged over dcn as int8 values + per-block fp32
-scales, dequantized once — the ICI reduce-scatter/all-gather legs and
-the returned gradient dtype are untouched, and ``compression=None`` is
-bit-identical to the uncompressed path.  Error feedback (on by
-default) carries the per-device quantization residual as explicit
-state: build it with :func:`init_comm_state`, thread it through
-``all_reduce_gradients(..., comm_state=...)`` (or the
+scales, dequantized once — by default the ICI reduce-scatter/
+all-gather legs and the returned gradient dtype are untouched, and
+``compression=None`` is bit-identical to the uncompressed path.
+``CompressionConfig(ici_legs=True)`` additionally runs BOTH ICI legs
+int8 (EQuARX's ICI half — ~4x fewer bytes on the fast links too,
+chunk boundaries preserved so nothing else moves).  Error feedback
+(on by default) carries the per-device quantization residual as
+explicit state: build it with :func:`init_comm_state` (it sizes the
+extra ``ici_push``/``ici_pull`` buffers from the config), thread it
+through ``all_reduce_gradients(..., comm_state=...)`` (or the
 ``DistributedDataParallel``/``Reducer`` equivalents), and checkpoint it
 with the rest of the training state.
 """
@@ -119,10 +123,17 @@ def _hierarchical_psum(g: jnp.ndarray, dcn_axis: str, ici_axis: str,
 
     With ``compression`` given, the AR(dcn) middle leg runs as an int8
     block-quantized all-reduce (:func:`apex_tpu.ops.quantization.
-    quantized_psum`) — the ICI legs and the output dtype are untouched,
-    and ``compression=None`` takes the exact uncompressed path.
-    Returns ``(out, new_residual)``; ``new_residual`` is None unless an
-    error-feedback ``residual`` dict was passed."""
+    quantized_psum`) — by default the ICI legs and the output dtype are
+    untouched, and ``compression=None`` takes the exact uncompressed
+    path.  With ``compression.ici_legs`` the RS/AG legs ALSO go int8
+    (EQuARX's ICI half): :func:`~apex_tpu.ops.quantization.
+    quantized_reduce_scatter` replaces the full-width ``psum_scatter``
+    (chunk boundaries preserved, so the dcn leg and its residual sizes
+    are unchanged) and :func:`~apex_tpu.ops.quantization.
+    quantized_all_gather` replaces the gather, each with its own
+    error-feedback buffer (``ici_push``/``ici_pull`` in the residual
+    dict).  Returns ``(out, new_residual)``; ``new_residual`` is None
+    unless an error-feedback ``residual`` dict was passed."""
     from apex_tpu.transformer.tensor_parallel.mappings import (
         all_gather_invariant,
     )
@@ -133,21 +144,82 @@ def _hierarchical_psum(g: jnp.ndarray, dcn_axis: str, ici_axis: str,
     pad = (-n) % ici
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    chunk = jax.lax.psum_scatter(flat, ici_axis, tiled=True)
+    ici_legs = compression is not None and compression.ici_legs
+    if ici_legs and residual is not None and "ici_push" not in residual:
+        raise ValueError(
+            "compression.ici_legs=True but the comm state has no "
+            "ici_push/ici_pull residuals: rebuild it with "
+            "init_comm_state(..., compression=<the ici_legs config>)"
+        )
+    if not ici_legs and residual is not None and "ici_push" in residual:
+        # the opposite mismatch would silently DROP the ici residuals
+        # from the returned state (an opaque out_specs/pytree error at
+        # best) — refuse with the same rebuild message
+        raise ValueError(
+            "the comm state carries ici_push/ici_pull residuals but "
+            "compression.ici_legs is False: rebuild it with "
+            "init_comm_state(..., compression=<this config>) or turn "
+            "ici_legs back on"
+        )
+    # one base dither key per (leaf, step), decorrelated per leg —
+    # sharing the caller's key across the three quantization sites
+    # would re-roll the same noise on different data
+    leg_key = lambda i: None
+    if compression is not None and compression.rounding == "stochastic":
+        base = key
+        if base is None and step is not None:
+            import jax as _jax
+
+            base = _jax.random.fold_in(_jax.random.PRNGKey(0), step)
+        if base is not None:
+            import jax as _jax
+
+            leg_key = lambda i: _jax.random.fold_in(base, i)
     new_residual = None
+    new_ici_push = new_ici_pull = None
+    if ici_legs:
+        from apex_tpu.ops.quantization import quantized_reduce_scatter
+
+        chunk, new_ici_push = quantized_reduce_scatter(
+            flat.astype(jnp.float32), ici_axis, compression,
+            residual=None if residual is None else residual["ici_push"],
+            step=step, key=leg_key(1),
+        )
+    else:
+        chunk = jax.lax.psum_scatter(flat, ici_axis, tiled=True)
     if compression is None:
         chunk = jax.lax.psum(chunk, dcn_axis)
     else:
         from apex_tpu.ops.quantization import quantized_psum
 
-        chunk, new_residual = quantized_psum(
-            chunk, dcn_axis, compression, residual=residual, step=step,
-            key=key,
+        dcn_residual = None
+        if residual is not None:
+            dcn_residual = {"push": residual["push"],
+                            "pull": residual["pull"]}
+        chunk, new_dcn = quantized_psum(
+            chunk, dcn_axis, compression, residual=dcn_residual,
+            step=step, key=leg_key(0) if ici_legs else key,
         )
-    # invariant-typed gather: every ici rank receives the identical
-    # dcn-reduced chunk, so the result is replicated over both data
-    # axes and downstream P() out_specs typecheck (same HLO either way)
-    out = all_gather_invariant(chunk, ici_axis, axis=0, tiled=True)
+        if residual is not None:
+            new_residual = dict(new_dcn)
+    if ici_legs:
+        from apex_tpu.ops.quantization import quantized_all_gather
+
+        out, new_ici_pull = quantized_all_gather(
+            chunk.astype(jnp.float32), ici_axis, compression,
+            residual=None if residual is None else residual["ici_pull"],
+            step=step, key=leg_key(2),
+        )
+        out = out.astype(flat.dtype)
+    else:
+        # invariant-typed gather: every ici rank receives the identical
+        # dcn-reduced chunk, so the result is replicated over both data
+        # axes and downstream P() out_specs typecheck (same HLO either
+        # way)
+        out = all_gather_invariant(chunk, ici_axis, axis=0, tiled=True)
+    if new_residual is not None and new_ici_push is not None:
+        new_residual["ici_push"] = new_ici_push
+        new_residual["ici_pull"] = new_ici_pull
     if pad:
         out = out[:n]
     return out.reshape(g.shape), new_residual
@@ -373,15 +445,25 @@ def emit_bucket_comm_events(plan, axis_name, cfg, where: str) -> None:
                 # int8 values + one fp32 scale per block (block-padded)
                 qpad = chunk + (-chunk) % cfg.block_size
                 ar_payload = qpad + (qpad // cfg.block_size) * 4
+            if cfg is not None and cfg.ici_legs:
+                # int8 legs: values at 1 byte + the per-row scale
+                # sidecar (one fp32 scale per block of each rank's
+                # chunk — quantize_rows keeps blocks inside chunks)
+                nb = max(-(-chunk // cfg.block_size), 1)
+                leg_payload = padded + ici * nb * 4
+                rs_bytes, ag_bytes = leg_payload, leg_payload
+            else:
+                rs_bytes, ag_bytes = padded_bytes, padded_bytes
             fields.update(
                 dcn_size=int(dcn), ici_size=int(ici),
+                ici_compressed=bool(cfg is not None and cfg.ici_legs),
                 rs_ici_wire_bytes=round(
-                    ring_wire_bytes("reduce-scatter", ici, padded_bytes)),
+                    ring_wire_bytes("reduce-scatter", ici, rs_bytes)),
                 ar_dcn_wire_bytes=round(
                     ring_wire_bytes("all-reduce", dcn, ar_payload)),
                 ag_ici_wire_bytes=round(
-                    ring_wire_bytes("all-gather", ici, padded_bytes,
-                                    result_bytes=padded_bytes)),
+                    ring_wire_bytes("all-gather", ici, ag_bytes,
+                                    result_bytes=ag_bytes)),
             )
         else:
             fields.update(
@@ -398,7 +480,7 @@ def _check_bucketed_state(plan, comm_state, cfg, dcn_axis,
     """Fail with an actionable message when the per-bucket residual
     sizes do not match the trace-time bucket plan (the shapes would
     otherwise error deep inside quantized_psum)."""
-    from apex_tpu.ops.quantization import comm_residual_sizes
+    from apex_tpu.ops.quantization import hierarchical_residual_sizes
 
     residuals = comm_state["residuals"]
     if set(residuals) != set(plan.names):
@@ -412,16 +494,24 @@ def _check_bucketed_state(plan, comm_state, cfg, dcn_axis,
         return
     dcn, ici = _axis_size(dcn_axis), _axis_size(ici_axis)
     for name, b in zip(plan.names, plan.buckets):
-        n = b.size
-        chunk = (n + (-n) % ici) // ici
-        padded, _ = comm_residual_sizes(chunk, dcn, cfg.block_size)
+        sizes = hierarchical_residual_sizes(
+            b.size, dcn, ici, cfg.block_size, cfg.ici_legs
+        )
+        if set(sizes) != set(residuals[name]):
+            raise ValueError(
+                f"residual '{name}' has keys "
+                f"{sorted(residuals[name])}, this compression config "
+                f"needs {sorted(sizes)}: the comm state was built for "
+                "a different config (ici_legs?) — rebuild with "
+                "init_comm_state"
+            )
         push = residuals[name]["push"]
-        if push.size != padded:
+        if push.size != sizes["push"]:
             raise ValueError(
                 f"residual '{name}' has {push.size} elements, the "
-                f"bucket's padded chunk is {padded}: init_comm_state "
-                "must use the same bucket_bytes and leaf dtypes as "
-                "the reduce"
+                f"bucket's padded chunk is {sizes['push']}: "
+                "init_comm_state must use the same bucket_bytes and "
+                "leaf dtypes as the reduce"
             )
 
 
@@ -461,7 +551,7 @@ def init_comm_state(
     instead of restarting the quantization bias from zero."""
     from apex_tpu.ops.quantization import (
         as_compression_config,
-        comm_residual_sizes,
+        hierarchical_residual_sizes,
     )
 
     cfg = as_compression_config(compression)
@@ -497,16 +587,17 @@ def init_comm_state(
         return n
 
     def one(leaf, spec):
-        n = local_size(leaf, spec)
-        chunk = (n + (-n) % ici) // ici
-        padded, shard = comm_residual_sizes(chunk, dcn, cfg.block_size)
+        sizes = hierarchical_residual_sizes(
+            local_size(leaf, spec), dcn, ici, cfg.block_size,
+            cfg.ici_legs,
+        )
         # a leaf sharded over MODEL axes (pp/tp stacks) carries a
         # DISTINCT residual per model-axis position as well — the
         # global buffer must hold every one of them
         reps = replicas * _model_axis_extent(spec, mesh)
         return {
-            "push": jnp.zeros((reps * padded,), jnp.float32),
-            "pull": jnp.zeros((reps * shard,), jnp.float32),
+            k: jnp.zeros((reps * n,), jnp.float32)
+            for k, n in sizes.items()
         }
 
     if param_specs is None:
@@ -553,8 +644,10 @@ def comm_state_specs(comm_state: dict,
         if buckets is not None:
             rs = {
                 name: {
-                    "push": P((dcn_axis, ici_axis, *b.model_axes)),
-                    "pull": P((dcn_axis, ici_axis, *b.model_axes)),
+                    # key set follows the state (push/pull, plus the
+                    # ici_push/ici_pull pair when ici_legs sized them)
+                    k: P((dcn_axis, ici_axis, *b.model_axes))
+                    for k in comm_state["residuals"][name]
                 }
                 for name, b in zip(buckets.names, buckets.buckets)
             }
@@ -583,13 +676,13 @@ def comm_state_specs(comm_state: dict,
 
     from apex_tpu.transformer.parallel_state import spec_axis_names
 
-    def leaf_spec(spec):
+    def leaf_spec(spec, res):
         axes = (dcn_axis, ici_axis, *spec_axis_names(spec))
-        return {"push": P(axes), "pull": P(axes)}
+        return {k: P(axes) for k in res}
 
     return {
         "residuals": jax.tree.map(
-            leaf_spec, param_specs,
+            leaf_spec, param_specs, comm_state["residuals"],
             is_leaf=lambda x: isinstance(x, P),
         ),
         "step": P(),

@@ -336,7 +336,7 @@ def bucket_comm_state(
     without it."""
     from apex_tpu.ops.quantization import (
         as_compression_config,
-        comm_residual_sizes,
+        hierarchical_residual_sizes,
     )
 
     cfg = as_compression_config(compression)
@@ -354,15 +354,15 @@ def bucket_comm_state(
 
     residuals = {}
     for name, b in zip(plan.names, plan.buckets):
-        n = b.size
-        chunk = (n + (-n) % ici) // ici
-        padded, shard = comm_residual_sizes(chunk, dcn, cfg.block_size)
+        sizes = hierarchical_residual_sizes(
+            b.size, dcn, ici, cfg.block_size, cfg.ici_legs
+        )
         reps = replicas
         if mesh is not None:
             for ax in b.model_axes:
                 reps *= mesh.shape[ax]
         residuals[name] = {
-            "push": jnp.zeros((reps * padded,), jnp.float32),
-            "pull": jnp.zeros((reps * shard,), jnp.float32),
+            k: jnp.zeros((reps * n,), jnp.float32)
+            for k, n in sizes.items()
         }
     return {"residuals": residuals, "step": jnp.zeros((), jnp.int32)}

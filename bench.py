@@ -962,6 +962,108 @@ def child_telemetry():
     }))
 
 
+def child_opttail():
+    """Optimizer-tail A/B row: ms/step of the fused multi-tensor tail
+    (``FusedAdam(fused_tail=True).step_scaled`` — unscale + finiteness
+    + Adam + master→bf16 cast in ONE pass over packed buffers) vs the
+    seed per-leaf chain (``scaler.unscale`` pass + per-leaf ``upd``),
+    on a flagship-layout GPT param tree scaled to the CPU dryrun
+    budget.  Always a CPU measurement, so per the PR 3 convention
+    ``vs_baseline`` is null — the real bandwidth gate is
+    ``tools/kernel_validation.py validate_opt_tail`` on the next TPU
+    capture (PROFILE_r05's 11.85 ms / 440 GB/s tail baseline); this
+    row tracks that both paths stay runnable, their relative cost, and
+    that fused-vs-per-leaf outputs stay BIT-identical."""
+    _pin_cpu()
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.amp.scaler import all_finite, scale_gradients
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.fused_tail import (
+        tail_traffic_bytes,
+        time_opt_tail,
+    )
+
+    LAYERS, HIDDEN, VOCAB = 2, 256, 4096  # child_gpt's CPU shape
+    ks = jax.random.split(jax.random.PRNGKey(0), LAYERS + 2)
+    params = {"emb": 0.02 * jax.random.normal(
+        ks[0], (VOCAB, HIDDEN), jnp.bfloat16)}
+    for l in range(LAYERS):
+        params[f"l{l}"] = {
+            "qkv": 0.02 * jax.random.normal(
+                ks[l + 1], (HIDDEN, 3 * HIDDEN), jnp.bfloat16),
+            "mlp": 0.02 * jax.random.normal(
+                ks[l + 1], (HIDDEN, 4 * HIDDEN), jnp.bfloat16),
+            "ln": jnp.ones((HIDDEN,), jnp.bfloat16),
+        }
+    grads = jax.tree.map(
+        lambda p: 0.01 * jax.random.normal(
+            jax.random.PRNGKey(9), jnp.shape(p),
+            jnp.float32).astype(p.dtype),
+        params)
+    inv = 1.0 / 1024.0
+
+    fused = FusedAdam(lr=1e-3, master_weights=True, fused_tail=True)
+    perleaf = FusedAdam(lr=1e-3, master_weights=True)
+    f_state, p_state = fused.init(params), perleaf.init(params)
+
+    # parity before timing: the fused tail's contract is bit-identity
+    fp, fs, _ = jax.jit(
+        lambda s, g, p: fused.step_scaled(s, g, p, jnp.float32(inv))
+    )(f_state, grads, params)
+    rg = scale_gradients(grads, inv)
+    rp, rs = jax.jit(
+        lambda s, g, p, f: perleaf.step(s, g, p, grads_finite=f)
+    )(p_state, rg, params, all_finite(grads))
+    for a, b in zip(jax.tree.leaves(fp), jax.tree.leaves(rp)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    f = time_opt_tail(fused, f_state, grads, params, inv_scale=inv,
+                      iters=10)
+
+    def seed_chain(s, g, p):
+        g2 = scale_gradients(g, inv)
+        finite = all_finite(g)
+        return perleaf.step(s, g2, p, grads_finite=finite)
+
+    jseed = jax.jit(seed_chain)
+    out = jseed(p_state, grads, params)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = jseed(p_state, grads, params)
+    jax.block_until_ready(out)
+    seed_ms = (time.perf_counter() - t0) / 10 * 1e3
+    n_elems = sum(int(np.prod(jnp.shape(l)))
+                  for l in jax.tree.leaves(params))
+    log(f"opt tail: fused {f['ms']:.2f} ms vs per-leaf "
+        f"{seed_ms:.2f} ms ({n_elems / 1e6:.1f}M params)")
+    print(json.dumps({
+        "metric": "opt_tail_ms_per_step",
+        "value": round(f["ms"], 3),
+        "unit": "ms (fused tail, CPU)",
+        "vs_baseline": None,
+        "platform": "cpu",
+        "note": "CPU-dryrun-scale tail; vs_baseline null per the PR 3 "
+                "convention — the bandwidth gate is kernel_validation "
+                "validate_opt_tail on TPU (11.85 ms r05 baseline). "
+                "fused_vs_per_leaf < 1 HERE is the CPU backend's "
+                "unfused concatenate (the bucket pack is a real copy "
+                "on CPU; TPU fuses concats into the consumer loop)",
+        "fused_ms": round(f["ms"], 3),
+        "per_leaf_ms": round(seed_ms, 3),
+        "fused_vs_per_leaf": round(seed_ms / max(f["ms"], 1e-9), 2),
+        "traffic_bytes": tail_traffic_bytes(params, fused),
+        "cpu_gbs": round(f["gbs"], 2),
+        "bit_identical": True,
+        "spec": {"layers": LAYERS, "hidden": HIDDEN, "vocab": VOCAB,
+                 "elements": n_elems, "steps": 10, "warmup": 2,
+                 "unscale_folded": True},
+    }))
+
+
 def _flash_long_seq(out, on_tpu, timeit):
     import jax
     import jax.numpy as jnp
@@ -1359,6 +1461,23 @@ def main():
     else:
         log(f"skipping grad-sync row: {budget_left():.0f}s budget left")
 
+    # optimizer-tail A/B row (fused multi-tensor pass vs the seed
+    # per-leaf chain) — rides BENCH_EXTRA.json, never the headline
+    if budget_left() > 150:
+        ok, ot, err = _run_child(
+            ["--child", "opttail", "--platform", "cpu"],
+            min(budget_left(), 600),
+        )
+        if ok:
+            extras = extras if extras is not None else {
+                "platform": "cpu-virtual"}
+            extras["opt_tail"] = ot
+            log(f"opt_tail: {ot}")
+        else:
+            log(f"opt-tail row failed (non-fatal): {err[-300:]}")
+    else:
+        log(f"skipping opt-tail row: {budget_left():.0f}s budget left")
+
     # telemetry-overhead row (metrics on vs off at the flagship
     # CPU-dryrun shape) — rides BENCH_EXTRA.json, never the headline
     if budget_left() > 150:
@@ -1423,6 +1542,8 @@ if __name__ == "__main__":
             child_extras(plat)
         elif kind == "gradsync":
             child_gradsync()
+        elif kind == "opttail":
+            child_opttail()
         elif kind == "telemetry":
             child_telemetry()
         else:

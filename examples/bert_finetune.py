@@ -71,7 +71,15 @@ def main(argv=None):
                     choices=["none", "int8"],
                     help="int8-quantize the DCN leg of the hierarchical "
                          "gradient reduce (requires --dp-ici-size)")
+    ap.add_argument("--compress-ici-legs", action="store_true",
+                    help="ALSO int8-quantize the ICI RS/AG legs of "
+                         "the hierarchical reduce (requires "
+                         "--grad-compression int8)")
     ap.add_argument("--no-error-feedback", action="store_true")
+    ap.add_argument("--fused-opt-tail", action="store_true",
+                    help="one multi-tensor optimizer-tail pass over "
+                         "packed buffers (bit-identical numerics; see "
+                         "docs/optimizers.md)")
     ap.add_argument("--overlap-grad-sync", action="store_true",
                     help="bucket the hierarchical gradient reduce so "
                          "the scheduler can overlap the per-bucket "
@@ -90,6 +98,12 @@ def main(argv=None):
         ap.error("--grad-compression requires --dp-ici-size")
     if args.overlap_grad_sync and not hier:
         ap.error("--overlap-grad-sync requires --dp-ici-size")
+    if args.compress_ici_legs and args.grad_compression == "none":
+        ap.error("--compress-ici-legs requires --grad-compression int8")
+    if args.fused_opt_tail and args.tp > 1:
+        ap.error("--fused-opt-tail needs replicated params (the "
+                 "packed state cannot be tp-sharded; see "
+                 "docs/optimizers.md)")
     bucket_bytes = int(args.bucket_mb * 1024 * 1024)
     comp = None
     if args.grad_compression != "none":
@@ -98,6 +112,7 @@ def main(argv=None):
         comp = CompressionConfig(
             method=args.grad_compression,
             error_feedback=not args.no_error_feedback,
+            ici_legs=args.compress_ici_legs,
         )
     mesh = parallel_state.initialize_model_parallel(
         tensor_model_parallel_size_=args.tp,
@@ -115,7 +130,8 @@ def main(argv=None):
     specs = model.param_specs()
     params = model.init(jax.random.PRNGKey(0))
     opt = FusedAdam(lr=args.lr,
-                    master_weights=mp.policy.master_weights)
+                    master_weights=mp.policy.master_weights,
+                    fused_tail=args.fused_opt_tail)
     opt_state = opt.init(params)
     opt_specs = state_specs_like(specs, opt_state)
 

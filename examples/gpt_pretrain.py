@@ -127,9 +127,29 @@ def main(argv=None):
                     help="elements per fp32 scale in the quantized leg")
     ap.add_argument("--compression-rounding", default="nearest",
                     choices=["nearest", "stochastic"])
+    ap.add_argument("--compress-ici-legs", action="store_true",
+                    help="ALSO int8-quantize the ICI reduce-scatter/"
+                         "all-gather legs of the hierarchical reduce "
+                         "(EQuARX's ICI half; requires "
+                         "--grad-compression int8) — ~4x fewer bytes "
+                         "on the fast links too")
     ap.add_argument("--no-error-feedback", action="store_true",
                     help="drop the quantization-residual compensation "
                          "state (lossier; mainly for A/B experiments)")
+    ap.add_argument("--fused-opt-tail", action="store_true",
+                    help="run the optimizer tail as ONE multi-tensor "
+                         "pass over bucketed buffers (moments/masters "
+                         "stored packed — bit-identical numerics, "
+                         "fewer HBM passes; checkpoints are NOT "
+                         "layout-compatible with the per-leaf state). "
+                         "FusedAdam path only (--zero shards its own "
+                         "flat buffer already)")
+    ap.add_argument("--exp-avg-sq-dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="storage dtype of Adam's second moment "
+                         "(bfloat16 halves its bytes in the fused "
+                         "tail; math stays fp32 — see "
+                         "docs/optimizers.md for when it is safe)")
     ap.add_argument("--overlap-grad-sync", action="store_true",
                     help="bucket the hierarchical gradient reduce "
                          "(reverse-layer order) so the scheduler can "
@@ -188,11 +208,25 @@ def main(argv=None):
         ap.error("--overlap-grad-sync applies to the DDP reduce; "
                  "--zero replaces it with the sharded optimizer's "
                  "reduce-scatter")
+    if args.fused_opt_tail and args.zero:
+        ap.error("--fused-opt-tail packs the replicated FusedAdam "
+                 "state; --zero's DistributedFusedAdam already runs "
+                 "its update on one flat sharded buffer")
+    if args.fused_opt_tail and (args.pp > 1 or args.tp > 1
+                                or args.num_experts):
+        ap.error("--fused-opt-tail needs replicated params: the "
+                 "packed state buffers concatenate leaves across "
+                 "bucket boundaries and cannot be sharded over "
+                 "pp/tp/ep axes (see docs/optimizers.md) — drop the "
+                 "flag or the model-parallel axes")
     bucket_bytes = int(args.bucket_mb * 1024 * 1024)
     if hier and args.num_experts:
         ap.error("--dp-ici-size is incompatible with --num-experts "
                  "(experts ride the dp axis, which the hierarchical "
                  "layout keeps at size 1)")
+    if args.compress_ici_legs and args.grad_compression == "none":
+        ap.error("--compress-ici-legs extends --grad-compression int8 "
+                 "to the ICI legs: enable int8 first")
     comp = None
     if args.grad_compression != "none":
         from apex_tpu.ops.quantization import CompressionConfig
@@ -202,6 +236,7 @@ def main(argv=None):
             block_size=args.compression_block,
             rounding=args.compression_rounding,
             error_feedback=not args.no_error_feedback,
+            ici_legs=args.compress_ici_legs,
         )
     mesh = parallel_state.initialize_model_parallel(
         tensor_model_parallel_size_=args.tp,
@@ -252,8 +287,13 @@ def main(argv=None):
         init_opt = jax.jit(shard_map(
             opt.init, mesh=mesh, in_specs=(specs,), out_specs=opt_specs))
     else:
+        # --fused-opt-tail: moments + masters live as packed bucket
+        # buffers and the whole clip→adam→cast chain is one pass per
+        # buffer (bit-identical at fp32 moments; see docs/optimizers.md)
         opt = FusedAdam(lr=args.lr,
-                        master_weights=mp.policy.master_weights)
+                        master_weights=mp.policy.master_weights,
+                        fused_tail=args.fused_opt_tail,
+                        exp_avg_sq_dtype=jnp.dtype(args.exp_avg_sq_dtype))
         opt_state = opt.init(params)
         opt_specs = state_specs_like(specs, opt_state)
 

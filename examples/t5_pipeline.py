@@ -48,6 +48,10 @@ def main(argv=None):
                     choices=["none", "int8"],
                     help="int8-quantize the DCN leg of the hierarchical "
                          "gradient reduce (requires --dp-ici-size)")
+    ap.add_argument("--compress-ici-legs", action="store_true",
+                    help="ALSO int8-quantize the ICI RS/AG legs of "
+                         "the hierarchical reduce (requires "
+                         "--grad-compression int8)")
     ap.add_argument("--no-error-feedback", action="store_true")
     ap.add_argument("--overlap-grad-sync", action="store_true",
                     help="bucket the hierarchical gradient reduce so "
@@ -62,6 +66,8 @@ def main(argv=None):
         ap.error("--grad-compression requires --dp-ici-size")
     if args.overlap_grad_sync and not hier:
         ap.error("--overlap-grad-sync requires --dp-ici-size")
+    if args.compress_ici_legs and args.grad_compression == "none":
+        ap.error("--compress-ici-legs requires --grad-compression int8")
     bucket_bytes = int(args.bucket_mb * 1024 * 1024)
     comp = None
     if args.grad_compression != "none":
@@ -70,6 +76,7 @@ def main(argv=None):
         comp = CompressionConfig(
             method=args.grad_compression,
             error_feedback=not args.no_error_feedback,
+            ici_legs=args.compress_ici_legs,
         )
 
     n = jax.device_count()
@@ -104,6 +111,10 @@ def main(argv=None):
     ))
     params = model.pipeline_params(model.init(jax.random.PRNGKey(0)))
     specs = model.pipeline_param_specs()
+    # no --fused-opt-tail here: the tail packs REPLICATED param state,
+    # and this trainer's params are always pp-stacked (the packed
+    # buffers cannot be described by a PartitionSpec — see
+    # docs/optimizers.md "Fused optimizer tail" scope note)
     opt = FusedAdam(lr=3e-3)
     opt_state = opt.init(params)
     opt_specs = state_specs_like(specs, opt_state)

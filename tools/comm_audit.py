@@ -232,26 +232,33 @@ def _axis_label(groups, pairs, coords):
 
 def classify_and_total(records, mesh, dcn_axis="dcn", ici_axis="ici"):
     """Label each collective by the mesh axes its groups span and total
-    the wire bytes per label.  Device ids map to (dcn, ici) coordinates
-    through the mesh's device grid."""
+    the wire bytes per label — and per LEG (``axis/op``), so the
+    RS(ici) and AG(ici) halves of the hierarchical reduce are
+    accounted separately from the AR(dcn) middle (the int8 gather
+    compression's win lives entirely in the ici legs).  Device ids map
+    to (dcn, ici) coordinates through the mesh's device grid.
+    Returns ``(per_axis_totals, per_leg_totals)``."""
     coords = _mesh_coords(mesh, dcn_axis, ici_axis)
     totals = {"dcn": 0.0, "ici": 0.0, "other": 0.0}
+    legs = {}
     for rec in records:
         label = _axis_label(rec["replica_groups"], rec["pairs"], coords)
         wb = _wire_bytes(rec)
         rec["axis"] = label
         rec["wire_bytes"] = wb
         totals[label] += wb
-    return totals
+        leg = f"{label}/{rec['op']}"
+        legs[leg] = legs.get(leg, 0.0) + wb
+    return totals, legs
 
 
 def audit_fn(jitted, args, mesh, dcn_axis="dcn", ici_axis="ici"):
     """Compile ``jitted`` for ``args``, walk the optimized HLO and
-    return ``(per_axis_totals, collective_records)``."""
+    return ``(per_axis_totals, per_leg_totals, collective_records)``."""
     txt = jitted.lower(*args).compile().as_text()
     records = parse_collectives(txt)
-    totals = classify_and_total(records, mesh, dcn_axis, ici_axis)
-    return totals, records
+    totals, legs = classify_and_total(records, mesh, dcn_axis, ici_axis)
+    return totals, legs, records
 
 
 def _shard_map():
@@ -296,10 +303,14 @@ def audit_gradient_sync(compression, ici_size=4, block_size=256,
     pspec = jax.tree.map(lambda _: P(), grads)
     shard_map = _shard_map()
 
-    cfg = None
-    if compression is not None:
+    if isinstance(compression, CompressionConfig):
+        cfg = compression
+        compression = cfg.method + ("+ici" if cfg.ici_legs else "")
+    elif compression is not None:
         cfg = CompressionConfig(method=compression,
                                 block_size=block_size)
+    else:
+        cfg = None
 
     if cfg is not None and cfg.error_feedback:
         cstate = init_comm_state(grads, axes, cfg, mesh=mesh)
@@ -317,17 +328,18 @@ def audit_gradient_sync(compression, ici_size=4, block_size=256,
         )
         args = (grads,)
 
-    totals, records = audit_fn(jax.jit(fn), args, mesh)
+    totals, legs, records = audit_fn(jax.jit(fn), args, mesh)
     n_elems = sum(
         int(jnp.size(l)) for l in jax.tree.leaves(grads)
     )
     return {
         "compression": compression or "none",
         "ici_size": ici_size,
-        "block_size": block_size,
+        "block_size": cfg.block_size if cfg is not None else block_size,
         "grad_elements": n_elems,
         "grad_bytes": n_elems * jnp.dtype(dtype).itemsize,
         "bytes_on_wire": {k: round(v, 1) for k, v in totals.items()},
+        "bytes_by_leg": {k: round(v, 1) for k, v in sorted(legs.items())},
         "collectives": [
             {"op": r["op"], "axis": r["axis"],
              "wire_bytes": round(r["wire_bytes"], 1)}
@@ -337,17 +349,54 @@ def audit_gradient_sync(compression, ici_size=4, block_size=256,
 
 
 def run_audit(ici_size=4, block_size=256):
-    """The before/after pair + the headline dcn reduction ratio."""
+    """The before/after TRIPLE + reduction ratios: compression=None,
+    DCN-only int8 (the headline ``value`` stays the dcn ratio for
+    record continuity), and int8 with ``ici_legs=True`` (the EQuARX
+    gather-leg half) with per-LEG compressed-vs-full ratios — the
+    number the multichip dryrun's ici config gates at >= 3x."""
+    from apex_tpu.ops.quantization import (
+        CompressionConfig as _CC,
+    )
+
     base = audit_gradient_sync(None, ici_size, block_size)
     comp = audit_gradient_sync("int8", ici_size, block_size)
+    gather = audit_gradient_sync(
+        _CC(block_size=block_size, ici_legs=True), ici_size, block_size
+    )
     ratio = (base["bytes_on_wire"]["dcn"]
              / max(comp["bytes_on_wire"]["dcn"], 1e-9))
+    ici_ratio = (base["bytes_on_wire"]["ici"]
+                 / max(gather["bytes_on_wire"]["ici"], 1e-9))
+    # SEMANTIC leg pairing, not name matching: the compressed RS
+    # lowers as an int8 all-to-all and the compressed dcn all-reduce
+    # as all-to-all + all-gather, so a same-key comparison would
+    # silently drop the reduce-scatter leg (the largest one) from the
+    # report
+    bl, gl = base["bytes_by_leg"], gather["bytes_by_leg"]
+
+    def _ratio(base_bytes, comp_bytes):
+        return round(base_bytes / comp_bytes, 2) if comp_bytes else None
+
+    leg_ratios = {
+        "rs_ici": _ratio(bl.get("ici/reduce-scatter", 0.0),
+                         gl.get("ici/all-to-all", 0.0)),
+        "ag_ici": _ratio(bl.get("ici/all-gather", 0.0),
+                         gl.get("ici/all-gather", 0.0)),
+        "ar_dcn": _ratio(bl.get("dcn/all-reduce", 0.0),
+                         gl.get("dcn/all-to-all", 0.0)
+                         + gl.get("dcn/all-gather", 0.0)),
+    }
     return {
         "metric": "dcn_gradient_bytes_ratio",
         "value": round(ratio, 2),
         "unit": "x fewer dcn bytes (int8 vs none)",
+        "ici_gather_ratio": round(ici_ratio, 2),
+        "ici_gather_ratio_unit": "x fewer ici bytes (int8 ici_legs "
+                                 "vs none, RS+AG legs)",
+        "leg_ratios_vs_gather_compressed": leg_ratios,
         "baseline": base,
         "compressed": comp,
+        "gather_compressed": gather,
     }
 
 

@@ -862,6 +862,121 @@ def validate_fused_dense(smoke=False):
     return results
 
 
+def validate_opt_tail(smoke=False):
+    """A/B the fused optimizer tail (PROFILE_r05.md's 11.85 ms →
+    6.35 ms bandwidth gap): ``FusedAdam(fused_tail=True).step_scaled``
+    — ONE multi-tensor pass folding unscale → finiteness → clip →
+    Adam → master→bf16 cast over packed buffers — against the
+    ``optimization_barrier``-unfused reference chain, where every
+    stage of the seed path (the scaler's unscale pass, the finiteness
+    reduction, each leaf's moment/update/cast loop) materializes to
+    HBM before the next reads it.  Values are identical (barriers
+    change no bits), so the row is pure bandwidth: ``achieved_gbs`` is
+    the fused pass's effective GB/s over the paper traffic model
+    (:func:`apex_tpu.optimizers.fused_tail.tail_traffic_bytes`) — the
+    number to read against the 440-vs-819 GB/s capture."""
+    from apex_tpu.amp.scaler import all_finite, scale_gradients
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.base import tree_where
+    from apex_tpu.optimizers.fused_tail import tail_traffic_bytes
+
+    barrier = jax.lax.optimization_barrier
+    layers, hidden = (2, 512) if smoke else (8, 1024)
+    ks = jax.random.split(jax.random.PRNGKey(7), layers + 2)
+    params = {"emb": 0.02 * jax.random.normal(
+        ks[0], (8192, hidden), jnp.bfloat16)}
+    for l in range(layers):
+        params[f"l{l}"] = {
+            "qkv": 0.02 * jax.random.normal(
+                ks[l + 1], (hidden, 3 * hidden), jnp.bfloat16),
+            "mlp": 0.02 * jax.random.normal(
+                ks[l + 1], (hidden, 4 * hidden), jnp.bfloat16),
+            "ln": jnp.ones((hidden,), jnp.bfloat16),
+        }
+    grads = jax.tree.map(
+        lambda p: 0.01 * jax.random.normal(
+            ks[-1], jnp.shape(p), jnp.float32).astype(p.dtype),
+        params)
+    inv = jnp.float32(1.0 / 1024.0)
+
+    results = []
+    for max_norm in (None, 1.0):
+        fused_opt = FusedAdam(lr=1e-3, master_weights=True,
+                              fused_tail=True, max_grad_norm=max_norm)
+        ref_opt = FusedAdam(lr=1e-3, master_weights=True,
+                            max_grad_norm=max_norm)
+        f_state = fused_opt.init(params)
+        r_state = ref_opt.init(params)
+
+        def out_scalar(p, s):
+            return sum(jnp.sum(l.astype(jnp.float32))
+                       for l in jax.tree.leaves(p)) + \
+                sum(jnp.sum(l.astype(jnp.float32))
+                    for l in jax.tree.leaves(s["exp_avg"]))
+
+        def fused_t(x, opt=fused_opt, state=f_state):
+            # x rides the scale so the whole update depends on the
+            # timing carry (nothing hoistable)
+            p, s, _ = opt.step_scaled(state, grads, params,
+                                      inv * (1.0 + x * 1e-30))
+            return out_scalar(p, s)
+
+        def unfused_t(x, opt=ref_opt, state=r_state):
+            # the seed chain with every stage materialized: unscale
+            # pass, finiteness pass, then the per-leaf update with its
+            # own barrier (each leaf's loop reads/writes HBM alone)
+            g = barrier(scale_gradients(grads, inv * (1.0 + x * 1e-30)))
+            finite = barrier(all_finite(g))
+            new_p, new_s = opt.step(state, g, params)
+            new_p = barrier(new_p)
+            new_p = tree_where(finite, new_p, params)
+            new_s = tree_where(finite, new_s, state)
+            return out_scalar(new_p, new_s)
+
+        # parity first: barriers change no values, the fused pass is
+        # bit-identical by the tail contract
+        pf, sf, _ = jax.jit(
+            lambda: fused_opt.step_scaled(f_state, grads, params, inv)
+        )()
+        pr, sr = jax.jit(
+            lambda: ref_opt.step(
+                r_state, scale_gradients(grads, inv), params,
+                grads_finite=all_finite(grads))
+        )()
+        err = max(
+            _max_err(a, b) for a, b in zip(
+                jax.tree.leaves(pf), jax.tree.leaves(pr))
+        )
+        x0 = jnp.float32(0.0)
+        f_ms = _time(fused_t, x0, iters=20)
+        u_ms = _time(unfused_t, x0, iters=20)
+        nbytes = tail_traffic_bytes(params, fused_opt)
+        results.append({
+            "kernel": "opt_tail",
+            "shape": [layers, hidden,
+                      sum(int(jnp.size(l))
+                          for l in jax.tree.leaves(params))],
+            "dtype": "bfloat16",
+            "clip": max_norm is not None,
+            # "pallas" = the shipped fused path, "xla" = the barrier-
+            # separated unfused chain (the fused_dense convention), so
+            # summary gate (2) enforces fused >= unfused
+            "pallas_ms": round(f_ms, 3),
+            "xla_ms": round(u_ms, 3),
+            "speedup": round(u_ms / f_ms, 2),
+            "max_err_vs_fp32": err,
+            "xla_err_vs_fp32": 0.0,
+            "traffic_bytes": nbytes,
+            "achieved_gbs": round(nbytes / (f_ms * 1e-3) / 1e9, 1),
+            "unfused_gbs": round(nbytes / (u_ms * 1e-3) / 1e9, 1),
+            "auto_impl": "pallas",
+            "note": "queued against PROFILE_r05's 11.85 ms / 440 GB/s "
+                    "optimizer-tail capture (paper bw 819 GB/s)",
+        })
+        print(json.dumps(results[-1]))
+    return results
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
@@ -879,6 +994,7 @@ def main():
     entries += validate_layer_norm(smoke=args.smoke)
     entries += validate_softmax(smoke=args.smoke)
     entries += validate_fused_dense(smoke=args.smoke)
+    entries += validate_opt_tail(smoke=args.smoke)
     from apex_tpu.ops.attention_mid import mid_seq_threshold
     from apex_tpu.ops.attention_short import short_seq_threshold
     doc = {

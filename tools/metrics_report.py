@@ -153,7 +153,13 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
                      "event": kind}
             for k in ("step", "path", "ok", "duration_s", "bytes",
                       "restored_step", "consecutive_bad", "bucket",
-                      "elapsed_s", "error"):
+                      "elapsed_s", "error",
+                      # opt_tail (fused optimizer pass) fields: shape
+                      # of the pass + its self-timed ms / achieved
+                      # GB/s when measured standalone
+                      "fused", "buffers", "buffer_bytes",
+                      "moment_dtype", "unscale_folded", "self_ms",
+                      "gbs"):
                 if k in r:
                     entry[k] = r[k]
             timeline.append(entry)
