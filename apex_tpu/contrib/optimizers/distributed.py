@@ -26,6 +26,22 @@ optimizer.
 Call :meth:`init` and :meth:`step` inside ``shard_map``; state specs come
 from :meth:`state_specs`.
 
+**Full-parameter sharding (ZeRO-3/FSDP)** — ``shard_params=True``:
+parameters themselves live permanently as the 1-D fp32 shard in the
+bucket-shaped flat layout (:class:`apex_tpu.parallel.zero3.Zero3Layout`
+over the PR 4 ``GradientBuckets`` plans), :meth:`gather_params`
+rebuilds the model-dtype tree per bucket ON USE (int8 + ``ag`` error
+feedback under ``CompressionConfig(ici_legs=True)``), gradients
+reduce-scatter straight into the shard and the update runs there in
+place — no replicated master, no tail all-gather, persistent
+per-device bytes down ~world-fold (the h≥4096 unlock,
+PROFILE_r05.md).  Entry points: :meth:`build_layout` (host-side,
+once), :meth:`init_shards`, :meth:`gather_params`, :meth:`step` (same
+method, shard-aware), :meth:`unshard_params` (checkpoint → replicated
+eval).  At ``compression=None`` the step is bit-identical to the
+state-sharding mode — a storage layout, not a numerics change.  See
+docs/distributed.md "Full-parameter sharding".
+
 MoE composition: pass ``param_specs=`` to :class:`DistributedFusedAdam`
 and leaves whose spec names the data axis (expert weights riding "dp"
 as ep) are updated rank-locally with fp32 masters instead of riding the
@@ -147,12 +163,35 @@ class _DistributedOptimizer:
     def __init__(self, lr: float, axis_name: Any = DATA_PARALLEL_AXIS,
                  compressed_allgather: Optional[str] = None,
                  param_specs: Any = None,
-                 compression: Any = None):
+                 compression: Any = None,
+                 shard_params: bool = False,
+                 bucket_bytes: Optional[int] = None):
         from apex_tpu.ops.quantization import as_compression_config
+        from apex_tpu.parallel.overlap import DEFAULT_BUCKET_BYTES
 
         if compressed_allgather not in (None, "bf16", "e5m2"):
             raise ValueError(
                 "compressed_allgather must be None, 'bf16' or 'e5m2'"
+            )
+        # ZeRO-3 / FSDP: parameters live permanently as 1-D fp32 shards
+        # in the bucket-shaped flat layout (apex_tpu/parallel/zero3.py)
+        # and are all-gathered to model dtype per bucket ON USE
+        # (:meth:`gather_params`); gradients reduce-scatter straight
+        # into the shard and the update runs on it in place — no
+        # replicated master, no tail all-gather.  Requires
+        # :meth:`build_layout` once, host-side, before any use.
+        self.shard_params = bool(shard_params)
+        self.bucket_bytes = (DEFAULT_BUCKET_BYTES if bucket_bytes is None
+                             else int(bucket_bytes))
+        if self.bucket_bytes < 1:
+            raise ValueError("bucket_bytes must be >= 1")
+        self._layout = None
+        if shard_params and compressed_allgather is not None:
+            raise ValueError(
+                "shard_params gathers weights in MODEL dtype already "
+                "(bf16 params move bf16 bytes) and compresses the "
+                "gather to int8 under CompressionConfig(ici_legs=True) "
+                "— compressed_allgather does not apply; drop it"
             )
         self.lr = lr
         self.axis_name = axis_name
@@ -188,6 +227,14 @@ class _DistributedOptimizer:
                       if param_specs is not None else None)
         if self._mask is not None and self._has_local(self._mask):
             # fail FAST, not at step-trace time
+            if self.shard_params:
+                raise NotImplementedError(
+                    "shard_params (ZeRO-3) does not support data-axis-"
+                    "sharded leaves: an expert shard has no replicated "
+                    "copy to re-shard, and the rank-local path performs "
+                    "no gather — drop param_specs' data-axis entries or "
+                    "use the state-sharding mode for MoE"
+                )
             if self._hierarchical:
                 raise NotImplementedError(
                     "data-axis-sharded leaves are not supported with "
@@ -284,6 +331,20 @@ class _DistributedOptimizer:
               else self._shard_axis)
         specs = {k: P(ax) for k in self._extra_init(1)}
         specs["step"] = P()
+        if self.shard_params:
+            # ZeRO-3: no master (the threaded shard is the master);
+            # per-BUCKET residuals — grad legs vary over both data
+            # axes, the param-AG residual rides ici only (it
+            # compensates the dcn-invariant shard)
+            if (self.compression is not None
+                    and self.compression.error_feedback):
+                from apex_tpu.parallel.zero3 import zero3_comm_specs
+
+                specs["comm"] = zero3_comm_specs(
+                    self.layout, self.axis_name, self.compression,
+                    model_axes=model_axes,
+                )
+            return specs
         specs["master"] = P(ax)
         if (self.compression is not None
                 and self.compression.error_feedback):
@@ -315,12 +376,110 @@ class _DistributedOptimizer:
                               **{k: lspec for k in moment_keys}}
         return specs
 
+    # ------------------------------------------------ ZeRO-3 (FSDP)
+    def build_layout(self, params_like: Any, mesh=None,
+                     world: Optional[int] = None):
+        """Build (and remember) the host-side ZeRO-3 shard layout for a
+        param pytree — REQUIRED once before any ``shard_params`` use.
+        ``params_like`` may be arrays or ``ShapeDtypeStruct``\\ s; pass
+        ``mesh`` so the shard-axis extent (and, with ``param_specs``,
+        per-device leaf shapes for pp/tp-sharded models) are derived,
+        or give ``world`` explicitly.  Returns the
+        :class:`~apex_tpu.parallel.zero3.Zero3Layout`."""
+        from apex_tpu.parallel.zero3 import Zero3Layout
+
+        if not self.shard_params:
+            raise ValueError(
+                "build_layout is the ZeRO-3 entry: construct the "
+                "optimizer with shard_params=True"
+            )
+        if world is None:
+            if mesh is None:
+                raise ValueError("build_layout needs mesh= or world=")
+            world = mesh.shape[self._shard_axis]
+        self._layout = Zero3Layout(
+            params_like, world, self.bucket_bytes,
+            param_specs=self.param_specs, mesh=mesh,
+        )
+        return self._layout
+
+    @property
+    def layout(self):
+        if self._layout is None:
+            raise ValueError(
+                "no ZeRO-3 layout built: call build_layout(params, "
+                "mesh=...) once, host-side, before init_shards/"
+                "gather_params/step"
+            )
+        return self._layout
+
+    def shard_spec(self, model_axes: Tuple[str, ...] = ()):
+        """Placement spec for the flat param shard (1/ici per device,
+        replicated across dcn; varying over ``model_axes`` when
+        composing with pp/tp — each position holds its own local
+        stack's shard)."""
+        ax = ((*model_axes, self._shard_axis) if model_axes
+              else self._shard_axis)
+        return P(ax)
+
+    def init_shards(self, params: Any) -> jnp.ndarray:
+        """Replicated params → this rank's permanent ``(shard_size,)``
+        fp32 shard (call inside shard_map; the shard IS the fp32
+        master from here on — the replicated tree can be dropped)."""
+        rank = lax.axis_index(self._shard_axis)
+        return self.layout.shard_params(params, rank)
+
+    def gather_params(
+        self, shards: jnp.ndarray, state: Optional[dict] = None,
+    ) -> Tuple[Any, Optional[dict]]:
+        """Gather-on-use: the full model-dtype param pytree from the
+        flat shard, one all-gather per bucket over the shard (ici)
+        axis — int8 + error feedback when the compression config says
+        ``ici_legs`` (the ``ag`` residual rides ``state["comm"]``).
+        Returns ``(params, state)`` with the residuals advanced; the
+        returned state is what :meth:`step` must then see (a skipped
+        overflow step keeps the advanced ``ag`` residual — the gather
+        consumed it on finite params, unlike the grad legs)."""
+        residuals = None
+        cfg = self.compression
+        if (state is not None and cfg is not None
+                and cfg.ici_legs and cfg.error_feedback):
+            residuals = state.get("comm")
+        params, new_res = self.layout.gather(
+            shards, self.axis_name, compression=cfg,
+            residuals=residuals,
+            step=None if state is None else state["step"],
+        )
+        if new_res is not None and state is not None:
+            state = dict(state)
+            state["comm"] = new_res
+        return params, state
+
+    def unshard_params(self, global_shards) -> Any:
+        """Host-side: a ZeRO-3 checkpoint's flat shard buffer (the
+        ``device_get`` of the placed shard array) → the full replicated
+        param pytree — resume into a replicated-eval setup with this.
+        Bit-identical to a full-width :meth:`gather_params`; under
+        int8 gathers (``ici_legs``) the device view is the lossy wire
+        format and this rebuild is the exact fp32 master, i.e. at
+        least as accurate."""
+        import numpy as _np
+
+        return self.layout.unshard(_np.asarray(global_shards))
+
     def init(self, params: Any) -> dict:
         """Build the sharded state — call inside shard_map with
         replicated params; each rank keeps only its flat shard
         (1/ici per device, replicated across dcn, when hierarchical).
         With ``param_specs`` given, data-axis-sharded leaves get a
-        rank-local fp32 master + moments instead (see __init__)."""
+        rank-local fp32 master + moments instead (see __init__).
+
+        ZeRO-3 (``shard_params=True``): pass the flat param SHARD from
+        :meth:`init_shards` instead — the state then holds only the
+        moments (the shard itself is the master, threaded separately)
+        plus the per-bucket comm residuals."""
+        if self.shard_params:
+            return self._init_zero3(params)
         local_tree = None
         if self._mask is not None:
             local_tree = self._mask_tree(params, self._mask, True)
@@ -356,6 +515,30 @@ class _DistributedOptimizer:
             }
         return state
 
+    def _init_zero3(self, shards: jnp.ndarray) -> dict:
+        """Moments + step (+ per-bucket residuals) for the flat shard;
+        no ``master`` — the shard is the master."""
+        shape = getattr(shards, "shape", None)
+        if shape is None or len(shape) != 1 \
+                or shape[0] != self.layout.shard_size:
+            raise ValueError(
+                f"init expected the ({self.layout.shard_size},) flat "
+                f"param shard (from init_shards), got "
+                f"{type(shards).__name__} of shape {shape} — in "
+                "ZeRO-3 mode the state is built from the shard, not "
+                "the replicated tree"
+            )
+        state = {"step": jnp.int32(0)}
+        state.update(self._extra_init(self.layout.shard_size))
+        if (self.compression is not None
+                and self.compression.error_feedback):
+            from apex_tpu.parallel.zero3 import zero3_comm_state
+
+            state["comm"] = zero3_comm_state(
+                self.layout, self.axis_name, self.compression
+            )
+        return state
+
     def step(
         self,
         state: dict,
@@ -380,7 +563,19 @@ class _DistributedOptimizer:
         models' pipeline ``data_reduce`` convention, which applies the
         1/n itself), pass ``local_grads_prenormalized=True`` to skip
         the division.
+
+        ZeRO-3 (``shard_params=True``): ``params`` is the flat
+        ``(shard_size,)`` param shard (the fp32 master), ``grads`` the
+        full per-rank gradient pytree from differentiating the
+        gathered weights.  The grads reduce-scatter straight into the
+        shard layout (int8 legs per the compression config), the
+        update runs on the shard in place, and there is NO tail
+        all-gather — the next step's :meth:`gather_params` is the
+        gather.  Returns ``(new_shard, new_state)``.
         """
+        if self.shard_params:
+            return self._step_zero3(state, grads, params, lr,
+                                    grads_finite)
         local_params = local_grads = None
         if self._mask is not None:
             local_params = self._mask_tree(params, self._mask, True)
@@ -513,6 +708,45 @@ class _DistributedOptimizer:
             )
         return new_params, new_state
 
+    def _step_zero3(self, state, grads, shards, lr, grads_finite):
+        """RS grads into the shard → in-place sharded update; the
+        reverted-on-overflow set is the grad-leg residuals and the
+        moments (the ``ag`` residual in the input state was advanced
+        by this step's gather on FINITE params and must survive the
+        skip)."""
+        layout = self.layout
+        world = _axis_size(self._shard_axis)
+        total = world
+        if self._cross_axis is not None:
+            total = world * _axis_size(self._cross_axis)
+        lr = f32(self.lr if lr is None else lr)
+        comm = state.get("comm")
+        g_shard, new_comm = layout.reduce_scatter_grads(
+            grads, self.axis_name, compression=self.compression,
+            residuals=comm, step=state["step"],
+        )
+        g_shard = g_shard / total
+        rank = lax.axis_index(self._shard_axis)
+        ids_local = layout.local_segment_ids(rank)
+        new_step = state["step"] + 1
+        extra = {
+            k: v for k, v in state.items()
+            if k not in ("step", "comm")
+        }
+        new_shard, new_extra = self._update_shard(
+            extra, new_step, g_shard, shards, lr, layout, ids_local
+        )
+        new_state = dict(new_extra)
+        new_state["step"] = new_step
+        if new_comm is not None:
+            new_state["comm"] = new_comm
+        elif comm is not None:
+            new_state["comm"] = comm
+        if grads_finite is not None:
+            new_state = tree_where(grads_finite, new_state, state)
+            new_shard = tree_where(grads_finite, new_shard, shards)
+        return new_shard, new_state
+
 
 class DistributedFusedAdam(_DistributedOptimizer):
     """Sharded Adam/AdamW
@@ -530,11 +764,15 @@ class DistributedFusedAdam(_DistributedOptimizer):
         compressed_allgather: Optional[str] = None,
         param_specs: Any = None,
         compression: Any = None,
+        shard_params: bool = False,
+        bucket_bytes: Optional[int] = None,
     ):
         super().__init__(lr=lr, axis_name=axis_name,
                          compressed_allgather=compressed_allgather,
                          param_specs=param_specs,
-                         compression=compression)
+                         compression=compression,
+                         shard_params=shard_params,
+                         bucket_bytes=bucket_bytes)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -603,11 +841,15 @@ class DistributedFusedLAMB(_DistributedOptimizer):
         compressed_allgather: Optional[str] = None,
         param_specs: Any = None,
         compression: Any = None,
+        shard_params: bool = False,
+        bucket_bytes: Optional[int] = None,
     ):
         super().__init__(lr=lr, axis_name=axis_name,
                          compressed_allgather=compressed_allgather,
                          param_specs=param_specs,
-                         compression=compression)
+                         compression=compression,
+                         shard_params=shard_params,
+                         bucket_bytes=bucket_bytes)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps

@@ -66,6 +66,7 @@ __all__ = [
     "dequantize_rows",
     "comm_residual_sizes",
     "hierarchical_residual_sizes",
+    "zero3_residual_sizes",
     "init_residual",
     "quantized_psum",
     "quantized_reduce_scatter",
@@ -276,6 +277,29 @@ def hierarchical_residual_sizes(
     if ici_legs:
         sizes["ici_push"] = ici * chunk
         sizes["ici_pull"] = chunk
+    return sizes
+
+
+def zero3_residual_sizes(
+    n: int, dcn: int, ici: int, block_size: int, ici_legs: bool = False
+) -> dict:
+    """Per-device error-feedback buffer lengths for ONE ZeRO-3 bucket of
+    ``n`` local elements.  The bucket's gradient reduces as RS(ici) →
+    AR(dcn) *into the shard* (no grad all-gather — the shard is where
+    the update runs), and its PARAMETERS all-gather from the shard on
+    use: ``push``/``pull`` compensate the DCN all-reduce of the owned
+    chunk exactly as in :func:`hierarchical_residual_sizes`; with
+    ``ici_legs``, ``ici_push`` covers the padded local grads quantized
+    before the reduce-scatter and ``ag`` covers the param chunk
+    quantized before the gather-on-use all-gather (the param-AG leg has
+    no analog in the gradient path — it replaces the ZeRO-1 tail
+    gather)."""
+    chunk = (n + (-n) % ici) // ici
+    padded, shard = comm_residual_sizes(chunk, dcn, block_size)
+    sizes = {"push": padded, "pull": shard}
+    if ici_legs:
+        sizes["ici_push"] = ici * chunk
+        sizes["ag"] = chunk
     return sizes
 
 

@@ -29,6 +29,10 @@ from apex_tpu.parallel.overlap import (  # noqa: F401
     DEFAULT_BUCKET_BYTES,
     GradientBuckets,
 )
+from apex_tpu.parallel.zero3 import (  # noqa: F401
+    Zero3Layout,
+    zero3_comm_state,
+)
 from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
     SyncBatchNorm,
     sync_batch_norm,
@@ -47,6 +51,8 @@ __all__ = [
     "hierarchical_data_parallel_mesh",
     "DEFAULT_BUCKET_BYTES",
     "GradientBuckets",
+    "Zero3Layout",
+    "zero3_comm_state",
     "SyncBatchNorm",
     "sync_batch_norm",
     "convert_syncbn_model",

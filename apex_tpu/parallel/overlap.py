@@ -207,7 +207,19 @@ class GradientBuckets:
         if dtype is not None:
             dtypes = [jnp.dtype(dtype)] * len(leaves)
         else:
-            dtypes = [jnp.asarray(l).dtype for l in leaves]
+            # honor a dtype attribute so abstract templates
+            # (ShapeDtypeStruct trees, e.g. from eval_shape on a
+            # model too big to materialize) plan identically to the
+            # real arrays they describe — CANONICALIZED, so a numpy
+            # float64 template plans the float32 the traced step will
+            # actually pack under default x64-off
+            import jax as _jax
+
+            dtypes = [
+                _jax.dtypes.canonicalize_dtype(l.dtype)
+                if hasattr(l, "dtype")
+                else jnp.asarray(l).dtype for l in leaves
+            ]
         axes = None
         if param_specs is not None and mesh is not None:
             from apex_tpu.transformer.parallel_state import spec_axis_names

@@ -44,7 +44,10 @@ __all__ = ["PHASES", "phase", "TraceTrigger"]
 logger = logging.getLogger("apex_tpu.telemetry")
 
 #: The step-anatomy phases the example trainers annotate.
-PHASES = ("data", "fwd_bwd", "grad_sync", "optimizer", "checkpoint")
+#: ``param_gather`` is the ZeRO-3 gather-on-use weight all-gather
+#: (apex_tpu/parallel/zero3.py) — present only under ``shard_params``.
+PHASES = ("data", "param_gather", "fwd_bwd", "grad_sync", "optimizer",
+          "checkpoint")
 
 #: Every span shares this prefix so a trace viewer filter of "tlm."
 #: shows exactly the phase segmentation.

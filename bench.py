@@ -839,6 +839,141 @@ def child_gradsync():
     }))
 
 
+def child_zero3():
+    """ZeRO-3 A/B row: ms/step of the full-parameter-sharding train
+    step (gather-on-use weights + reduce-scatter grads + sharded
+    update) vs the replicated FusedAdam step at the flagship
+    CPU-dryrun GPT shape on the 8-virtual-device dp mesh, plus the
+    param-gather cost measured in isolation.  Always a CPU
+    measurement, so per the PR 3 convention ``vs_baseline`` is null —
+    the memory win is proven structurally by MEMORY_AUDIT (compiled
+    per-device bytes) and the wire win by ZERO3_AUDIT; this row tracks
+    that the sharded path stays runnable and its step-time tax across
+    PRs."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    _pin_cpu()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.tensor_parallel.layers import (
+        state_specs_like,
+    )
+    from apex_tpu._compat import shard_map
+
+    # the flagship CPU-dryrun shape (child_gpt's fallback config)
+    VOCAB, LAYERS, HIDDEN, HEADS, SEQ, BATCH = 4096, 2, 256, 4, 256, 8
+    WARMUP, STEPS = 2, 10
+    BUCKET_KB = 256
+    mesh = parallel_state.initialize_model_parallel()
+    model = GPTModel(GPTConfig(
+        vocab_size=VOCAB, num_layers=LAYERS, hidden_size=HIDDEN,
+        num_attention_heads=HEADS, max_position_embeddings=SEQ,
+        compute_dtype=jnp.float32, attention_impl="xla", remat=False,
+    ))
+    params = model.init(jax.random.PRNGKey(0))
+    specs = model.param_specs()
+    place = lambda tree, sp: jax.device_put(
+        tree, jax.tree.map(lambda s: NamedSharding(mesh, s), sp,
+                           is_leaf=lambda x: isinstance(x, P)))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ),
+                                0, VOCAB)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    def measure(fn, *args):
+        for _ in range(WARMUP):
+            out = fn(*args)
+        jax.block_until_ready(jax.tree.leaves(out)[-1])
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            out = fn(*args)
+        jax.block_until_ready(jax.tree.leaves(out)[-1])
+        return (time.perf_counter() - t0) / STEPS * 1e3
+
+    # replicated baseline
+    ropt = FusedAdam(lr=1e-4, master_weights=True)
+    rstate = ropt.init(params)
+    rspecs = state_specs_like(specs, rstate)
+
+    def rep_step(p, s, tok, tgt):
+        loss, grads = jax.value_and_grad(model.loss)(p, tok, tgt)
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+        p, s = ropt.step(s, grads, p)
+        return p, s, loss
+
+    rstep = jax.jit(shard_map(
+        rep_step, mesh=mesh,
+        in_specs=(specs, rspecs, P("dp"), P("dp")),
+        out_specs=(specs, rspecs, P())))
+    rep_ms = measure(rstep, place(params, specs),
+                     place(rstate, rspecs), tokens, targets)
+
+    # zero3: gather-on-use
+    opt = DistributedFusedAdam(lr=1e-4, shard_params=True,
+                               bucket_bytes=BUCKET_KB * 1024)
+    opt.build_layout(params, mesh=mesh)
+    sspec, stspecs = opt.shard_spec(), opt.state_specs()
+    shards = jax.jit(shard_map(
+        opt.init_shards, mesh=mesh, in_specs=(specs,),
+        out_specs=sspec))(place(params, specs))
+    state = jax.jit(shard_map(
+        opt.init, mesh=mesh, in_specs=(sspec,),
+        out_specs=stspecs))(shards)
+
+    def z3_step(sh, s, tok, tgt):
+        p, s = opt.gather_params(sh, s)
+        loss, grads = jax.value_and_grad(model.loss)(p, tok, tgt)
+        sh, s = opt.step(s, grads, sh)
+        return sh, s, loss
+
+    zstep = jax.jit(shard_map(
+        z3_step, mesh=mesh,
+        in_specs=(sspec, stspecs, P("dp"), P("dp")),
+        out_specs=(sspec, stspecs, P())))
+    z3_ms = measure(zstep, shards, state, tokens, targets)
+
+    # the gather alone: what one full weight materialization costs
+    def gather_only(sh):
+        p, _ = opt.gather_params(sh)
+        return sum(jnp.sum(l) for l in jax.tree.leaves(p))
+
+    gfn = jax.jit(shard_map(
+        gather_only, mesh=mesh, in_specs=(sspec,), out_specs=P()))
+    gather_ms = measure(gfn, shards)
+
+    n_params = sum(int(l.size) for l in jax.tree.leaves(params))
+    log(f"zero3: replicated {rep_ms:.2f} ms/step, zero3 {z3_ms:.2f} "
+        f"ms/step, param-gather alone {gather_ms:.2f} ms")
+    print(json.dumps({
+        "metric": "zero3_ms_per_step",
+        "value": round(z3_ms, 3),
+        "unit": "ms/step (8 virtual CPU devices)",
+        # no TPU measurement happened on this mesh: null, not a fake
+        # ratio (PR 3 convention)
+        "vs_baseline": None,
+        "platform": "cpu-virtual",
+        "note": "relative cost only — the memory win is MEMORY_AUDIT's "
+                "compiled bytes, the wire win ZERO3_AUDIT's; this row "
+                "tracks the sharded step's runnable cost across PRs",
+        "ms_per_step_replicated": round(rep_ms, 3),
+        "ms_per_step_zero3": round(z3_ms, 3),
+        "param_gather_ms": round(gather_ms, 3),
+        "exposed_zero3_tax_ms": round(max(z3_ms - rep_ms, 0.0), 3),
+        "spec": {"vocab": VOCAB, "layers": LAYERS, "hidden": HIDDEN,
+                 "heads": HEADS, "seq": SEQ, "batch": BATCH,
+                 "n_params": n_params, "bucket_kb": BUCKET_KB,
+                 "steps": STEPS, "warmup": WARMUP},
+    }))
+
+
 def child_telemetry():
     """Telemetry-overhead row: ms/step of the flagship CPU-dryrun-shape
     GPT step (the same reduced config child_gpt's CPU fallback
@@ -1478,6 +1613,23 @@ def main():
     else:
         log(f"skipping opt-tail row: {budget_left():.0f}s budget left")
 
+    # ZeRO-3 A/B row (gather-on-use sharded step vs replicated at the
+    # dryrun shape) — rides BENCH_EXTRA.json, never the headline
+    if budget_left() > 150:
+        ok, z3, err = _run_child(
+            ["--child", "zero3", "--platform", "cpu"],
+            min(budget_left(), 600),
+        )
+        if ok:
+            extras = extras if extras is not None else {
+                "platform": "cpu-virtual"}
+            extras["zero3"] = z3
+            log(f"zero3: {z3}")
+        else:
+            log(f"zero3 row failed (non-fatal): {err[-300:]}")
+    else:
+        log(f"skipping zero3 row: {budget_left():.0f}s budget left")
+
     # telemetry-overhead row (metrics on vs off at the flagship
     # CPU-dryrun shape) — rides BENCH_EXTRA.json, never the headline
     if budget_left() > 150:
@@ -1542,6 +1694,8 @@ if __name__ == "__main__":
             child_extras(plat)
         elif kind == "gradsync":
             child_gradsync()
+        elif kind == "zero3":
+            child_zero3()
         elif kind == "opttail":
             child_opttail()
         elif kind == "telemetry":

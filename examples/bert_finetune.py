@@ -76,6 +76,13 @@ def main(argv=None):
                          "the hierarchical reduce (requires "
                          "--grad-compression int8)")
     ap.add_argument("--no-error-feedback", action="store_true")
+    ap.add_argument("--zero3", "--param-shard", action="store_true",
+                    dest="zero3",
+                    help="full-parameter sharding (ZeRO-3/FSDP): "
+                         "params live as 1-D fp32 shards over the "
+                         "data axis, gathered per bucket on use; "
+                         "grads reduce-scatter into the shard "
+                         "(--bucket-mb sizes the gather buckets)")
     ap.add_argument("--fused-opt-tail", action="store_true",
                     help="one multi-tensor optimizer-tail pass over "
                          "packed buffers (bit-identical numerics; see "
@@ -104,6 +111,10 @@ def main(argv=None):
         ap.error("--fused-opt-tail needs replicated params (the "
                  "packed state cannot be tp-sharded; see "
                  "docs/optimizers.md)")
+    if args.fused_opt_tail and args.zero3:
+        ap.error("--fused-opt-tail packs replicated FusedAdam state; "
+                 "--zero3 already runs the update on one flat sharded "
+                 "buffer")
     bucket_bytes = int(args.bucket_mb * 1024 * 1024)
     comp = None
     if args.grad_compression != "none":
@@ -129,11 +140,29 @@ def main(argv=None):
     model = BertModel(cfg)
     specs = model.param_specs()
     params = model.init(jax.random.PRNGKey(0))
-    opt = FusedAdam(lr=args.lr,
-                    master_weights=mp.policy.master_weights,
-                    fused_tail=args.fused_opt_tail)
-    opt_state = opt.init(params)
-    opt_specs = state_specs_like(specs, opt_state)
+    if args.zero3:
+        from apex_tpu.contrib.optimizers import (
+            DistributedFusedAdam,
+            reestablish_replicated,
+        )
+
+        opt = DistributedFusedAdam(
+            lr=args.lr, param_specs=specs,
+            axis_name=data_axes if hier else "dp",
+            compression=comp, shard_params=True,
+            bucket_bytes=bucket_bytes)
+        opt.build_layout(params, mesh=mesh)
+        shard_spec = opt.shard_spec(model_axes=("tp",))
+        opt_specs = opt.state_specs(model_axes=("tp",))
+        init_shards = jax.jit(shard_map(
+            opt.init_shards, mesh=mesh, in_specs=(specs,),
+            out_specs=shard_spec))
+    else:
+        opt = FusedAdam(lr=args.lr,
+                        master_weights=mp.policy.master_weights,
+                        fused_tail=args.fused_opt_tail)
+        opt_state = opt.init(params)
+        opt_specs = state_specs_like(specs, opt_state)
 
     def cls_loss(p, tokens, mask, labels):
         hidden = model.encode(p, tokens, attention_mask=mask)
@@ -145,8 +174,10 @@ def main(argv=None):
                 jax.lax.pmean(jnp.mean(acc), data_axes))
 
     # error-feedback residual state for the compressed reduce
-    # (per-BUCKET residuals when the reduce is bucketed)
-    use_comm = comp is not None and comp.error_feedback
+    # (per-BUCKET residuals when the reduce is bucketed; under --zero3
+    # the residuals ride the optimizer state instead)
+    use_comm = (comp is not None and comp.error_feedback
+                and not args.zero3)
     if use_comm:
         from apex_tpu.parallel.distributed import (
             comm_state_specs,
@@ -172,10 +203,20 @@ def main(argv=None):
         comm_state, comm_specs = {}, {}
 
     def train_step(p, s, comm, tokens, mask, labels):
+        # --zero3: p is the flat fp32 shard; gather-on-use rebuilds
+        # the model-dtype weights per bucket inside the step
+        if args.zero3:
+            w, s = opt.gather_params(p, s)
+            if args.tp > 1:
+                w = reestablish_replicated(w, specs)
+        else:
+            w = p
         with phase("fwd_bwd"):
             (loss, acc), grads = jax.value_and_grad(
-                cls_loss, has_aux=True)(p, tokens, mask, labels)
-        if hier:
+                cls_loss, has_aux=True)(w, tokens, mask, labels)
+        if args.zero3:
+            pass  # the optimizer's reduce-scatter IS the grad sync
+        elif hier:
             from apex_tpu.parallel import all_reduce_gradients
 
             if use_comm:
@@ -198,25 +239,42 @@ def main(argv=None):
         return p, s, comm, loss, acc
 
     data_spec = P(data_axes if hier else "dp")
+    store_spec = shard_spec if args.zero3 else specs
     jstep = jax.jit(
         shard_map(
             train_step, mesh=mesh,
-            in_specs=(specs, opt_specs, comm_specs,
+            in_specs=(store_spec, opt_specs, comm_specs,
                       data_spec, data_spec, data_spec),
-            out_specs=(specs, opt_specs, comm_specs, P(), P()),
+            out_specs=(store_spec, opt_specs, comm_specs, P(), P()),
         ),
         donate_argnums=(0, 1),
     )
+
+    def eval_fn(p, tokens, mask, labels):
+        if args.zero3:
+            p, _ = opt.gather_params(p)
+            if args.tp > 1:
+                p = reestablish_replicated(p, specs)
+        return cls_loss(p, tokens, mask, labels)
+
     jeval = jax.jit(shard_map(
-        cls_loss, mesh=mesh,
-        in_specs=(specs, data_spec, data_spec, data_spec),
+        eval_fn, mesh=mesh,
+        in_specs=(store_spec, data_spec, data_spec, data_spec),
         out_specs=(P(), P()),
     ))
 
     place = lambda t, sp: jax.device_put(
         t, jax.tree.map(lambda s: NamedSharding(mesh, s), sp,
                         is_leaf=lambda x: isinstance(x, P)))
-    p, s = place(params, specs), place(opt_state, opt_specs)
+    if args.zero3:
+        p = init_shards(place(params, specs))
+        s = jax.jit(shard_map(
+            opt.init, mesh=mesh, in_specs=(shard_spec,),
+            out_specs=opt_specs))(p)
+        jax.block_until_ready(p)
+        del params  # the shards are the storage — drop the full tree
+    else:
+        p, s = place(params, specs), place(opt_state, opt_specs)
     cst = place(comm_state, comm_specs)
     global_batch = args.batch * dp
     rng = np.random.default_rng(0)

@@ -9,8 +9,10 @@ assemble from Megatron pieces, wired TPU-native end to end:
   (O5 bf16 default; pass ``--opt-level O2`` for fp16 + dynamic scaler);
 - the dispatched 1F1B pipeline schedule (``pipeline_1f1b_grads``) with
   microbatch gradient accumulation;
-- FusedAdam with fp32 masters, or ``--zero`` for the reduce-scatter /
-  all-gather sharded ``DistributedFusedAdam``;
+- FusedAdam with fp32 masters, ``--zero`` for the reduce-scatter /
+  all-gather sharded ``DistributedFusedAdam``, or ``--zero3`` for
+  FULL-parameter sharding (gather-on-use weights, sharded update, no
+  replicated copy — the h≥4096-class memory unlock);
 - dynamic loss scaling with model-parallel overflow consensus (fp16
   levels only — bf16 needs none);
 - async, atomic checkpointing + SIGTERM-safe autoresume;
@@ -112,6 +114,19 @@ def main(argv=None):
     ap.add_argument("--zero", action="store_true",
                     help="shard optimizer state over dp "
                          "(DistributedFusedAdam)")
+    ap.add_argument("--zero3", "--param-shard", action="store_true",
+                    dest="zero3",
+                    help="FULL-parameter sharding (ZeRO-3/FSDP): "
+                         "params live as 1-D fp32 shards over the "
+                         "data axis and are all-gathered to model "
+                         "dtype per bucket ON USE (--bucket-mb sizes "
+                         "the buckets); grads reduce-scatter straight "
+                         "into the shard and the update runs there — "
+                         "per-device state bytes drop ~world-fold, "
+                         "unlocking models replicated DDP cannot "
+                         "hold.  Checkpoints store the shard buffer "
+                         "(resume at the same dp topology; "
+                         "see docs/distributed.md)")
     ap.add_argument("--dp-ici-size", type=int, default=None,
                     help="split data parallelism into a (dcn, ici) "
                          "hierarchy with this many replicas per "
@@ -198,20 +213,29 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     hier = args.dp_ici_size is not None
+    any_zero = args.zero or args.zero3
+    if args.zero and args.zero3:
+        ap.error("--zero and --zero3 are one knob at two depths: "
+                 "state sharding vs full parameter sharding — pick "
+                 "one")
+    if args.zero3 and args.num_experts:
+        ap.error("--zero3 cannot shard data-axis-sharded expert "
+                 "leaves (they have no replicated copy to re-shard); "
+                 "use --zero for MoE")
     if args.grad_compression != "none" and not hier:
         ap.error("--grad-compression quantizes the DCN leg of the "
                  "hierarchical reduce: it requires --dp-ici-size")
     if args.overlap_grad_sync and not hier:
         ap.error("--overlap-grad-sync buckets the hierarchical data "
                  "sync: it requires --dp-ici-size")
-    if args.overlap_grad_sync and args.zero:
+    if args.overlap_grad_sync and any_zero:
         ap.error("--overlap-grad-sync applies to the DDP reduce; "
-                 "--zero replaces it with the sharded optimizer's "
-                 "reduce-scatter")
-    if args.fused_opt_tail and args.zero:
+                 "--zero/--zero3 replace it with the sharded "
+                 "optimizer's reduce-scatter")
+    if args.fused_opt_tail and any_zero:
         ap.error("--fused-opt-tail packs the replicated FusedAdam "
-                 "state; --zero's DistributedFusedAdam already runs "
-                 "its update on one flat sharded buffer")
+                 "state; --zero/--zero3 already run the update on "
+                 "one flat sharded buffer")
     if args.fused_opt_tail and (args.pp > 1 or args.tp > 1
                                 or args.num_experts):
         ap.error("--fused-opt-tail needs replicated params: the "
@@ -267,7 +291,7 @@ def main(argv=None):
         t, jax.tree.map(lambda s: NamedSharding(mesh, s), sp,
                         is_leaf=lambda x: isinstance(x, P)))
 
-    if args.zero:
+    if any_zero:
         from apex_tpu.contrib.optimizers import (
             DistributedFusedAdam,
             reestablish_replicated,
@@ -277,15 +301,32 @@ def main(argv=None):
         # through the rank-local update instead of the flat RS/AG.
         # Hierarchical: RS rides ici, the 1/ici shard all-reduces
         # across dcn (int8-quantized when --grad-compression is set,
-        # residual state inside the optimizer state)
+        # residual state inside the optimizer state).  --zero3
+        # additionally shards the PARAMS: they live as the flat fp32
+        # shard and are gathered per bucket on use inside the step
+        # (int8 gather under --compress-ici-legs)
         opt = DistributedFusedAdam(
             lr=args.lr, param_specs=specs,
             axis_name=data_axes if hier else "dp",
             compression=comp,
+            shard_params=args.zero3,
+            bucket_bytes=bucket_bytes,
         )
-        opt_specs = opt.state_specs(model_axes=("pp", "tp"))
-        init_opt = jax.jit(shard_map(
-            opt.init, mesh=mesh, in_specs=(specs,), out_specs=opt_specs))
+        if args.zero3:
+            opt.build_layout(params, mesh=mesh)
+            shard_spec = opt.shard_spec(model_axes=("pp", "tp"))
+            init_shards = jax.jit(shard_map(
+                opt.init_shards, mesh=mesh, in_specs=(specs,),
+                out_specs=shard_spec))
+            opt_specs = opt.state_specs(model_axes=("pp", "tp"))
+            init_opt = jax.jit(shard_map(
+                opt.init, mesh=mesh, in_specs=(shard_spec,),
+                out_specs=opt_specs))
+        else:
+            opt_specs = opt.state_specs(model_axes=("pp", "tp"))
+            init_opt = jax.jit(shard_map(
+                opt.init, mesh=mesh, in_specs=(specs,),
+                out_specs=opt_specs))
     else:
         # --fused-opt-tail: moments + masters live as packed bucket
         # buffers and the whole clip→adam→cast chain is one pass per
@@ -301,7 +342,7 @@ def main(argv=None):
     # residuals, and the step counter stochastic rounding derives its
     # per-step key from (ZeRO carries its own inside the optimizer
     # state)
-    use_comm = (comp is not None and not args.zero
+    use_comm = (comp is not None and not any_zero
                 and (comp.error_feedback
                      or comp.rounding == "stochastic"))
     if use_comm:
@@ -333,13 +374,24 @@ def main(argv=None):
 
     def train_step(params, opt_state, amp_state, comm_state,
                    tokens, targets):
+        # --zero3: ``params`` is the flat fp32 shard; gather-on-use
+        # rebuilds the model-dtype tree per bucket (tlm.param_gather
+        # scopes inside), advancing the ag residual when the gather is
+        # int8 + error feedback.  The replicated-typed invariant over
+        # pp/tp is re-established for the pipeline/TP collectives.
+        if args.zero3:
+            weights, opt_state = opt.gather_params(params, opt_state)
+            if args.pp > 1 or args.tp > 1:
+                weights = reestablish_replicated(weights, specs)
+        else:
+            weights = params
         # tlm.* phase scopes: xprof segments the compiled step's
         # timeline by phase (fwd_bwd / grad_sync / optimizer) instead
         # of by mangled fusion names — see docs/observability.md
         with phase("fwd_bwd"):
             if pp_path:
                 loss, grads = model.pipeline_1f1b_grads(
-                    params, tokens, targets, args.num_micro)
+                    weights, tokens, targets, args.num_micro)
                 if use_scaler:
                     # fp16 + pipeline: scale the already-computed grads
                     # so the scaler's overflow-skip + adjustment state
@@ -356,9 +408,9 @@ def main(argv=None):
                     loss = model.loss(p, tokens, targets)
                     return mp.scale_loss(amp_state, loss), loss
 
-                grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+                grads, loss = jax.grad(loss_fn, has_aux=True)(weights)
                 loss = jax.lax.pmean(loss, "dp")
-        if not pp_path and not args.zero and not hier:
+        if not pp_path and not any_zero and not hier:
             # spec-aware dp sync: replicated leaves pmean (a no-op
             # re-establishing invariance — model.loss's internal
             # pmean already made their grads globally complete);
@@ -399,7 +451,7 @@ def main(argv=None):
         else:
             finite = None
         new_comm = comm_state
-        if hier and not args.zero:
+        if hier and not any_zero:
             # data sync AFTER the unscale: the compressed reduce sees
             # true-magnitude grads (the error-feedback residual is then
             # consistent across dynamic loss-scale changes), RS rides
@@ -431,7 +483,13 @@ def main(argv=None):
                 # (tp/pp shards + expert-dp leaves psum, replicated
                 # leaves count once)
                 grads, _ = clip_grad_norm(grads, specs, args.clip_grad)
-            if args.zero:
+            if args.zero3:
+                # grads reduce-scatter straight into the shard; the
+                # update runs there and NOTHING gathers back — the
+                # next step's gather-on-use is the gather
+                new_params, new_opt = opt.step(
+                    opt_state, grads, params, grads_finite=finite)
+            elif args.zero:
                 # expert grads are optimizer-ready in BOTH paths here:
                 # the pipeline's data_reduce applies the 1/n itself,
                 # and the pp=1 path's model.loss pmeans the loss inside
@@ -449,17 +507,30 @@ def main(argv=None):
 
     amp_specs = jax.tree.map(lambda _: P(), amp_state)
     data_spec = P(data_axes if hier else "dp")
+    # the threaded "params" are the flat shard under --zero3 — the
+    # replicated tree never exists between steps
+    store_spec = shard_spec if args.zero3 else specs
     step = jax.jit(
         shard_map(
             train_step, mesh=mesh,
-            in_specs=(specs, opt_specs, amp_specs, comm_specs,
+            in_specs=(store_spec, opt_specs, amp_specs, comm_specs,
                       data_spec, data_spec),
-            out_specs=(specs, opt_specs, amp_specs, comm_specs, P()),
+            out_specs=(store_spec, opt_specs, amp_specs, comm_specs,
+                       P()),
         ),
         donate_argnums=(0, 1),
     )
 
+    n_params = sum(int(np.prod(jnp.shape(l)))
+                   for l in jax.tree.leaves(params))
     placed = place(params, specs)
+    if args.zero3:
+        # the shards are the storage from here on: drop the replicated
+        # init tree, or a full param copy stays pinned all run and the
+        # ~world-fold persistent-bytes win never materializes
+        placed = init_shards(placed)
+        jax.block_until_ready(placed)
+        del params
     start = 0
     ar = None
     restored = None
@@ -469,7 +540,10 @@ def main(argv=None):
                         install_sigterm_handler=True)
         restored, start = ar.resume()
         if restored is not None:
-            placed = place(restored["params"], specs)
+            # --zero3 checkpoints hold the flat shard buffer (1/world
+            # the bytes of the replicated tree); resume at the same
+            # data-parallel topology
+            placed = place(restored["params"], store_spec)
             amp_state = mp.load_state_dict(restored["amp"])
             if use_comm and "comm" in restored:
                 # resumed error-feedback residuals keep the
@@ -479,7 +553,7 @@ def main(argv=None):
             print(f"resuming after step {start - 1}")
     # optimizer state AFTER the resume decision, so a restored run
     # never reverts to freshly-initialised masters
-    if args.zero:
+    if any_zero:
         opt_state = (place(restored["opt"], opt_specs)
                      if restored is not None and "opt" in restored
                      else init_opt(placed))
@@ -499,8 +573,6 @@ def main(argv=None):
     # flushes; tokens/s + MFU come from the same FLOP model bench.py /
     # tools/scale_mfu.py report, timed from AFTER the first step so the
     # XLA compile never pollutes ms/step
-    n_params = sum(int(np.prod(jnp.shape(l)))
-                   for l in jax.tree.leaves(params))
     stats = StepStats(
         tokens_per_step=global_batch * args.seq,
         flops_per_token=transformer_flops_per_token(

@@ -53,6 +53,15 @@ def main(argv=None):
                          "the hierarchical reduce (requires "
                          "--grad-compression int8)")
     ap.add_argument("--no-error-feedback", action="store_true")
+    ap.add_argument("--zero3", "--param-shard", action="store_true",
+                    dest="zero3",
+                    help="full-parameter sharding (ZeRO-3/FSDP) over "
+                         "the data axis, composed with the pipeline: "
+                         "each pp stage keeps its local stack as a "
+                         "1-D fp32 shard, gathered per bucket on use")
+    ap.add_argument("--bucket-mb-zero3", type=float, default=None,
+                    help="ZeRO-3 gather bucket size in MiB "
+                         "(defaults to --bucket-mb)")
     ap.add_argument("--overlap-grad-sync", action="store_true",
                     help="bucket the hierarchical gradient reduce so "
                          "the scheduler can overlap the per-bucket "
@@ -114,14 +123,38 @@ def main(argv=None):
     # no --fused-opt-tail here: the tail packs REPLICATED param state,
     # and this trainer's params are always pp-stacked (the packed
     # buffers cannot be described by a PartitionSpec — see
-    # docs/optimizers.md "Fused optimizer tail" scope note)
-    opt = FusedAdam(lr=3e-3)
-    opt_state = opt.init(params)
-    opt_specs = state_specs_like(specs, opt_state)
+    # docs/optimizers.md "Fused optimizer tail" scope note).  --zero3
+    # composes fine: each (pp, tp) position runs its own data-axis
+    # shard of its local stack (model_axes in every spec below)
+    if args.zero3:
+        from apex_tpu.contrib.optimizers import (
+            DistributedFusedAdam,
+            reestablish_replicated,
+        )
+
+        zb = args.bucket_mb_zero3
+        opt = DistributedFusedAdam(
+            lr=3e-3, param_specs=specs,
+            axis_name=data_axes if hier else "dp",
+            compression=comp, shard_params=True,
+            bucket_bytes=int((args.bucket_mb if zb is None else zb)
+                             * 1024 * 1024))
+        opt.build_layout(params, mesh=mesh)
+        shard_spec = opt.shard_spec(model_axes=("pp", "tp"))
+        opt_specs = opt.state_specs(model_axes=("pp", "tp"))
+        init_shards = jax.jit(shard_map(
+            opt.init_shards, mesh=mesh, in_specs=(specs,),
+            out_specs=shard_spec))
+    else:
+        opt = FusedAdam(lr=3e-3)
+        opt_state = opt.init(params)
+        opt_specs = state_specs_like(specs, opt_state)
 
     # error-feedback residual state for the compressed reduce
-    # (per-BUCKET residuals when the reduce is bucketed)
-    use_comm = comp is not None and comp.error_feedback
+    # (per-BUCKET residuals when the reduce is bucketed; under --zero3
+    # the residuals ride the optimizer state instead)
+    use_comm = (comp is not None and comp.error_feedback
+                and not args.zero3)
     if use_comm:
         from apex_tpu.parallel.distributed import (
             comm_state_specs,
@@ -154,12 +187,23 @@ def main(argv=None):
         # Hierarchical dp: the internal pmean rides the size-1 dummy
         # axis, so the data mean over (dcn, ici) happens explicitly —
         # RS(ici) -> AR(dcn, int8 when compressed) -> AG(ici)
+        # --zero3: gather the local stack's weights per bucket first,
+        # re-establishing the replicated typing over pp/tp the
+        # pipeline collectives expect
+        if args.zero3:
+            weights, opt_state = opt.gather_params(params, opt_state)
+            weights = reestablish_replicated(weights, specs)
+        else:
+            weights = params
         with phase("fwd_bwd"):
             loss, grads = jax.value_and_grad(
                 lambda p: model.pipeline_loss(p, enc, dec, tgt,
                                               num_microbatches=2)
-            )(params)
-        if hier:
+            )(weights)
+        if args.zero3:
+            if hier:
+                loss = jax.lax.pmean(loss, data_axes)
+        elif hier:
             from apex_tpu.parallel import all_reduce_gradients
 
             loss = jax.lax.pmean(loss, data_axes)
@@ -179,11 +223,12 @@ def main(argv=None):
         return params, opt_state, comm, loss
 
     data_spec = P(data_axes if hier else "dp")
+    store_spec = shard_spec if args.zero3 else specs
     step = jax.jit(shard_map(
         train_step, mesh=mesh,
-        in_specs=(specs, opt_specs, comm_specs,
+        in_specs=(store_spec, opt_specs, comm_specs,
                   data_spec, data_spec, data_spec),
-        out_specs=(specs, opt_specs, comm_specs, P()),
+        out_specs=(store_spec, opt_specs, comm_specs, P()),
     ))
     place = lambda tree, sp: jax.device_put(
         tree, jax.tree.map(lambda s: NamedSharding(mesh, s), sp,
@@ -195,7 +240,15 @@ def main(argv=None):
     dec_tokens = jnp.flip(enc_tokens, axis=1)
     targets = jnp.roll(dec_tokens, -1, axis=1)
 
-    p, s = place(params, specs), place(opt_state, opt_specs)
+    if args.zero3:
+        p = init_shards(place(params, specs))
+        s = jax.jit(shard_map(
+            opt.init, mesh=mesh, in_specs=(shard_spec,),
+            out_specs=opt_specs))(p)
+        jax.block_until_ready(p)
+        del params  # the shards are the storage — drop the full tree
+    else:
+        p, s = place(params, specs), place(opt_state, opt_specs)
     cst = place(comm_state, comm_specs)
     # async harvesting: the loss stays a device future between flushes
     # — no per-step host sync; ms/step excludes the first-step compile

@@ -138,11 +138,20 @@ _OP_RE = re.compile(
 )
 
 
+#: tlm.<phase> named scopes survive into each op's HLO metadata
+#: (``op_name``), which is what lets the audit tell a ZeRO-3
+#: param-gather all-gather apart from a gradient-sync one — same op,
+#: same axis, different phase.
+_PHASE_RE = re.compile(r"tlm\.(\w+)")
+
+
 def parse_collectives(hlo_text: str):
     """Extract collective ops from HLO text: one record per op with
-    the op kind, result/operand payload bytes and replica groups.
-    ``-done`` halves of async pairs are skipped (the ``-start`` op
-    carries the payload)."""
+    the op kind, result/operand payload bytes, replica groups and —
+    when the op carries a ``tlm.<phase>`` named scope in its metadata
+    — the step phase (``param_gather`` for ZeRO-3 weight gathers,
+    ``grad_sync`` for gradient reduces).  ``-done`` halves of async
+    pairs are skipped (the ``-start`` op carries the payload)."""
     out = []
     for line in hlo_text.splitlines():
         m = _OP_RE.search(line)
@@ -151,6 +160,8 @@ def parse_collectives(hlo_text: str):
         if m.group(3) == "-done":
             continue
         op = m.group(2)
+        pm = _PHASE_RE.search(line)
+        phase = pm.group(1) if pm else None
         result_bytes = sum(
             _shape_bytes(d, s)
             for d, s in _SHAPE_RE.findall(m.group(1))
@@ -177,6 +188,7 @@ def parse_collectives(hlo_text: str):
             ]
         out.append({
             "op": op,
+            "phase": phase,
             "result_bytes": result_bytes,
             "operand_bytes": operand_bytes,
             "replica_groups": groups,
@@ -397,6 +409,112 @@ def run_audit(ici_size=4, block_size=256):
         "baseline": base,
         "compressed": comp,
         "gather_compressed": gather,
+    }
+
+
+def phase_leg_totals(records):
+    """Wire-byte totals keyed ``phase/axis/op`` (phase ``other`` when
+    the op carries no ``tlm.*`` scope) — the view that separates the
+    ZeRO-3 param-gather legs from the gradient legs.  Call after
+    :func:`classify_and_total` (it stamps ``axis``/``wire_bytes``)."""
+    out = {}
+    for r in records:
+        key = f"{r.get('phase') or 'other'}/{r['axis']}/{r['op']}"
+        out[key] = out.get(key, 0.0) + r["wire_bytes"]
+    return {k: round(v, 1) for k, v in sorted(out.items())}
+
+
+def audit_zero3_step(compression, ici_size=4, block_size=256,
+                     bucket_kb=64, shapes=GPT_ISH_SHAPES):
+    """Compile one ZeRO-3 train step (gather-on-use → grads → RS into
+    the shard → sharded update) over a GPT-shaped param pytree and
+    audit its collectives, split param-AG vs grad legs by the
+    ``tlm.param_gather`` / ``tlm.grad_sync`` HLO metadata."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.ops.quantization import CompressionConfig
+    from apex_tpu.parallel import hierarchical_data_parallel_mesh
+
+    mesh = hierarchical_data_parallel_mesh(ici_size=ici_size)
+    axes = ("dcn", "ici")
+    shard_map = _shard_map()
+    params = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s, jnp.float32), shapes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    if isinstance(compression, str):
+        compression = CompressionConfig(method=compression,
+                                        block_size=block_size,
+                                        error_feedback=False)
+    opt = DistributedFusedAdam(
+        lr=1e-2, axis_name=axes, shard_params=True,
+        bucket_bytes=bucket_kb * 1024, compression=compression)
+    layout = opt.build_layout(params, mesh=mesh)
+    pspec = jax.tree.map(lambda _: P(), params)
+    sspec, stspec = opt.shard_spec(), opt.state_specs()
+
+    def step(sh, st, g):
+        p, st = opt.gather_params(sh, st)
+        # grads must DEPEND on the gathered weights or DCE folds the
+        # gather away; + 0*p is free and keeps the dataflow honest
+        g = jax.tree.map(lambda gi, pi: gi + 0.0 * pi, g, p)
+        return opt.step(st, g, sh)
+
+    fn = jax.jit(shard_map(
+        step, mesh, (sspec, stspec, pspec), (sspec, stspec),
+    ))
+    sh = jax.ShapeDtypeStruct(
+        (ici_size * layout.shard_size,), jnp.float32)
+    st = {"step": jax.ShapeDtypeStruct((), jnp.int32),
+          "exp_avg": sh, "exp_avg_sq": sh}
+    totals, legs, records = audit_fn(fn, (sh, st, params), mesh)
+    phases = phase_leg_totals(records)
+    param_ag = sum(v for k, v in phases.items()
+                   if k.startswith("param_gather/"))
+    grad = sum(v for k, v in phases.items()
+               if k.startswith("grad_sync/"))
+    cfg = compression
+    return {
+        "compression": ("none" if cfg is None else
+                        cfg.method + ("+ici" if cfg.ici_legs else "")),
+        "ici_size": ici_size,
+        "bucket_kb": bucket_kb,
+        "shard_elements": layout.shard_size,
+        "bytes_on_wire": {k: round(v, 1) for k, v in totals.items()},
+        "bytes_by_phase_leg": phases,
+        "param_ag_wire_bytes": round(param_ag, 1),
+        "grad_wire_bytes": round(grad, 1),
+    }
+
+
+def run_zero3_audit(ici_size=4, block_size=256, bucket_kb=64):
+    """The ZeRO-3 before/after pair: full-width param gathers vs int8
+    (``ici_legs=True``) ones, with the headline ``value`` the param-AG
+    wire-bytes ratio the multichip dryrun's zero3 config gates at
+    ≥ 3x, plus the grad-leg ratio for completeness (the grads ride the
+    same chunk-preserving int8 legs as the DDP path)."""
+    from apex_tpu.ops.quantization import CompressionConfig as _CC
+
+    base = audit_zero3_step(None, ici_size, block_size, bucket_kb)
+    comp = audit_zero3_step(
+        _CC(block_size=block_size, ici_legs=True,
+            error_feedback=False),
+        ici_size, block_size, bucket_kb)
+    ratio = (base["param_ag_wire_bytes"]
+             / max(comp["param_ag_wire_bytes"], 1e-9))
+    grad_ratio = (base["grad_wire_bytes"]
+                  / max(comp["grad_wire_bytes"], 1e-9))
+    return {
+        "metric": "zero3_param_ag_bytes_ratio",
+        "value": round(ratio, 2),
+        "unit": "x fewer param-AG wire bytes (int8 ici_legs vs "
+                "full-width model dtype)",
+        "grad_leg_ratio": round(grad_ratio, 2),
+        "baseline": base,
+        "gather_compressed": comp,
     }
 
 
@@ -785,6 +903,11 @@ def main():
                     help="audit the scheduled HLO of the pipelined "
                          "accumulate-and-reduce loop instead of the "
                          "bytes A/B (writes OVERLAP_AUDIT.json)")
+    ap.add_argument("--zero3", action="store_true",
+                    help="audit the ZeRO-3 gather-on-use step instead: "
+                         "param-AG vs grad legs split by phase "
+                         "metadata, full-width vs int8 gathers "
+                         "(writes ZERO3_AUDIT.json)")
     ap.add_argument("--num-micro", type=int, default=3)
     ap.add_argument("--bucket-kb", type=int, default=96)
     ap.add_argument("--min-overlappable", type=float, default=None,
@@ -795,6 +918,27 @@ def main():
     _force_virtual_devices(args.devices)
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.zero3:
+        out_path = args.out or os.path.join(root, "ZERO3_AUDIT.json")
+        doc = run_zero3_audit(args.ici_size, args.block_size)
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({
+            "metric": doc["metric"], "value": doc["value"],
+            "unit": doc["unit"],
+            "grad_leg_ratio": doc["grad_leg_ratio"],
+            "param_ag_bytes_none":
+                doc["baseline"]["param_ag_wire_bytes"],
+            "param_ag_bytes_int8":
+                doc["gather_compressed"]["param_ag_wire_bytes"],
+        }))
+        print(f"wrote {out_path}")
+        if args.min_ratio is not None and doc["value"] < args.min_ratio:
+            raise SystemExit(
+                f"param-AG bytes ratio {doc['value']} < floor "
+                f"{args.min_ratio}"
+            )
+        return
     if args.overlap:
         out_path = args.out or os.path.join(root, "OVERLAP_AUDIT.json")
         doc = run_overlap_audit(args.ici_size, args.bucket_kb,
