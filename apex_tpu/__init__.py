@@ -22,6 +22,7 @@ Layout (mirrors the reference's component inventory, see SURVEY.md §2):
 - :mod:`apex_tpu.transformer`    — Megatron-style tensor/pipeline parallel toolkit
 - :mod:`apex_tpu.contrib`        — xentropy, ASP sparsity, MHA modules, …
 - :mod:`apex_tpu.telemetry`      — runtime metrics (async scalar harvesting), subsystem events, phase traces
+- :mod:`apex_tpu.serving`        — inference: paged KV cache, fused sampling, continuous batching
 """
 
 __version__ = "0.1.0"
@@ -84,7 +85,7 @@ from apex_tpu import reparameterization  # noqa: E402
 # `apex_tpu.checkpoint`, `apex_tpu.resilience`, `apex_tpu.telemetry`
 # resolve on first attribute access
 _LAZY = ("transformer", "models", "contrib", "ops", "checkpoint",
-         "resilience", "telemetry")
+         "resilience", "telemetry", "serving")
 
 
 def __getattr__(name):
@@ -115,6 +116,7 @@ __all__ = [
     "checkpoint",
     "resilience",
     "telemetry",
+    "serving",
     "logger",
     "__version__",
 ]

@@ -56,7 +56,27 @@ from apex_tpu.transformer.tensor_parallel.random import (
 )
 from apex_tpu._compat import axis_size as _axis_size
 
-__all__ = ["GPTConfig", "GPTModel"]
+__all__ = ["GPTConfig", "GPTModel", "GPTDecodeFns"]
+
+
+@dataclasses.dataclass
+class GPTDecodeFns:
+    """The compiled serving step pair :meth:`GPTModel.decode_fns`
+    returns.  ``prefill``/``decode`` are params-bound callables matching
+    :class:`apex_tpu.serving.serve.ContinuousBatcher`'s contract;
+    ``prefill_jit``/``decode_jit`` are the underlying ``jax.jit``
+    objects (their ``_cache_size()`` is what the no-recompile tests
+    assert on)."""
+
+    prefill: Any
+    decode: Any
+    prefill_jit: Any
+    decode_jit: Any
+    #: the EOS id the compiled decode step freezes slots at.  Mirrored
+    #: as ``decode.eos_id`` so :class:`ContinuousBatcher` (which only
+    #: sees the callables) can reject a mismatched truncation id — the
+    #: device's freeze rule and the host's truncation rule must agree.
+    eos_id: Any = None
 
 
 @dataclasses.dataclass
@@ -369,6 +389,43 @@ class GPTModel:
         return specs
 
     # ------------------------------------------------------------- forward
+    def _qkv_heads(self, lp: Dict[str, Any], y: jnp.ndarray):
+        """(b, s, h) normed activations -> (q, k, v), each
+        ``(b, heads_local, s, head_dim)``.  The output dim of the fused
+        qkv weight is grouped per head — [h0_q h0_k h0_v h1_q …] — so a
+        contiguous tp slice holds whole (q,k,v) triplets and the math
+        is identical for every tp size (the reference relies on
+        per-rank weight init for the same property,
+        apex/transformer/testing/standalone_gpt.py).  The ONE
+        projection split shared by training (:meth:`_layer`), prefill
+        (:meth:`prefill_forward`) and decode (:meth:`decode_step`), so
+        the cache can never hold a different K than training computed."""
+        c = self.config
+        world = _axis_size(self.axis_name)
+        heads_local = c.num_attention_heads // world
+        b, s, _ = y.shape
+        qkv = self.qkv.apply(lp["qkv"], y)  # (b, s, 3h/tp)
+        qkv = qkv.reshape(b, s, heads_local, 3, c.head_dim)
+        return tuple(
+            jnp.moveaxis(qkv[:, :, :, i], 2, 1) for i in range(3)
+        )
+
+    def _dense_mlp(self, lp: Dict[str, Any], y: jnp.ndarray) -> jnp.ndarray:
+        """The dense-MLP math on normed activations: SwiGLU
+        (silu(gate(x)) * up(x) — both column-parallel on the same
+        input, elementwise gate on the local shard) or fc1+gelu, then
+        the row-parallel fc2.  The ONE definition shared by training
+        (:meth:`_layer`) and decode (:meth:`decode_step`), for the same
+        reason as :meth:`_qkv_heads`: the serving path must not be able
+        to drift from the math the model trained with."""
+        if self.fc_gate is not None:
+            y = (jax.nn.silu(self.fc_gate.apply(lp["fc_gate"], y))
+                 * self.fc1.apply(lp["fc1"], y))
+        else:
+            y = self.fc1.apply(lp["fc1"], y)
+            y = jax.nn.gelu(y, approximate=True)
+        return self.fc2.apply(lp["fc2"], y)
+
     def _layer(self, lp: Dict[str, Any], x: jnp.ndarray, key,
                rope=None) -> jnp.ndarray:
         """One transformer layer on the local shard. x: (b, s, h) replicated
@@ -383,16 +440,7 @@ class GPTModel:
         # -- attention block ------------------------------------------
         residual = x
         y = self._norm(lp["ln1"], x).astype(c.compute_dtype)
-        # output dim of the fused qkv weight is grouped per head —
-        # [h0_q h0_k h0_v h1_q …] — so a contiguous tp slice holds whole
-        # (q,k,v) triplets and the math is identical for every tp size
-        # (the reference relies on per-rank weight init for the same
-        # property, apex/transformer/testing/standalone_gpt.py)
-        qkv = self.qkv.apply(lp["qkv"], y)  # (b, s, 3h/tp)
-        qkv = qkv.reshape(b, s, heads_local, 3, c.head_dim)
-        q, k, v = (
-            jnp.moveaxis(qkv[:, :, :, i], 2, 1) for i in range(3)
-        )  # each (b, heads_local, s, d)
+        q, k, v = self._qkv_heads(lp, y)  # each (b, heads_local, s, d)
         if rope is not None:
             from apex_tpu.ops.rope import apply_rope_tables
 
@@ -451,15 +499,7 @@ class GPTModel:
         if self.moe is not None:
             y, aux = self.moe.apply(lp["moe"], y)
         else:
-            if self.fc_gate is not None:
-                # SwiGLU: silu(gate(x)) * up(x) — both column-parallel
-                # on the same input, elementwise gate on the local shard
-                y = (jax.nn.silu(self.fc_gate.apply(lp["fc_gate"], y))
-                     * self.fc1.apply(lp["fc1"], y))
-            else:
-                y = self.fc1.apply(lp["fc1"], y)
-                y = jax.nn.gelu(y, approximate=True)
-            y = self.fc2.apply(lp["fc2"], y)
+            y = self._dense_mlp(lp, y)
             aux = jnp.float32(0.0)
         if c.hidden_dropout > 0.0 and key is not None:
             hkey = data_parallel_key(jax.random.fold_in(key, 2))
@@ -607,6 +647,403 @@ class GPTModel:
 
             loss = jax.lax.pmean(loss, CONTEXT_PARALLEL_AXIS)
         return loss
+
+    # ------------------------------------------------- serving / decode
+    def prefill_forward(
+        self, params: Dict[str, Any], tokens: jnp.ndarray
+    ):
+        """Prompt ingestion: full forward over ``tokens (b, s)`` through
+        the TRAINING attention ladder (prefill is a compute-bound
+        s_q == s_k problem — exactly what rungs 1–3 are measured for),
+        additionally returning the attention-ready per-layer K/V for
+        the cache write.  Returns ``(hidden (b, s, h), k, v)`` with
+        k/v ``(num_layers, b, heads_local, s, head_dim)`` — K already
+        RoPE-rotated where the config says so, so a cached key is
+        rotated exactly once and the decode kernel rotates only q.
+
+        The layer output comes from :meth:`_layer` itself (key=None —
+        the inference path) and the K/V are recomputed from the same
+        ``lp``/``x`` through :meth:`_qkv_heads`; XLA CSEs the duplicate
+        norm+projection, and sharing the primitives is what makes the
+        paged generation bit-comparable to the full-recompute
+        reference."""
+        c = self.config
+        if c.context_parallel:
+            raise NotImplementedError(
+                "prefill_forward is the serving path — context-parallel "
+                "decode is not supported")
+        x = self._embed(params, tokens)
+        rope = (self._rope_tables(tokens.shape[1])
+                if c.position_embedding == "rope" else None)
+
+        def body(x, lp):
+            out, _aux = self._layer(lp, x, None, rope=rope)
+            y = self._norm(lp["ln1"], x).astype(c.compute_dtype)
+            _, k, v = self._qkv_heads(lp, y)
+            if rope is not None:
+                from apex_tpu.ops.rope import apply_rope_tables
+
+                k = apply_rope_tables(k, *rope)
+            return out, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+        x = self._norm(params["final_ln"], x.astype(jnp.float32))
+        return x.astype(c.compute_dtype), ks, vs
+
+    def decode_step(
+        self,
+        params: Dict[str, Any],
+        tokens: jnp.ndarray,
+        positions: jnp.ndarray,
+        active: jnp.ndarray,
+        page_table: jnp.ndarray,
+        pools: Dict[str, jnp.ndarray],
+        *,
+        quantized: bool = False,
+        kv_block: int = 128,
+    ):
+        """ONE fused decode step for a fixed batch of serving slots —
+        call inside shard_map.  ``tokens (S,)`` are the current tokens
+        (each sitting at 0-based ``positions[s]``), ``active (S,)``
+        masks live slots (idle slots compute garbage and write to the
+        null page).  Every layer writes its new K/V into its pool slice
+        (write-before-attend: the token attends to itself) and runs
+        :func:`~apex_tpu.ops.attention_decode.fmha_decode` against the
+        paged cache, with the q-side RoPE rotation fused into the
+        kernel.  Returns ``(logits (S, vocab/tp), new_pools)`` — the
+        shapes never change, so the serving driver's admissions and
+        retirements cannot recompile this."""
+        from apex_tpu.ops.attention_decode import fmha_decode
+        from apex_tpu.serving.kv_cache import write_targets, write_tokens
+
+        c = self.config
+        if self.moe is not None:
+            raise NotImplementedError("MoE decode is not supported")
+        S = tokens.shape[0]
+        page_size = pools["k"].shape[3]
+        positions = positions.astype(jnp.int32)
+
+        x = self.embedding.apply(params["embedding"], tokens[:, None])
+        if c.position_embedding == "learned":
+            pos = jnp.clip(positions, 0, c.max_position_embeddings - 1)
+            x = x + jnp.take(
+                params["pos_embedding"], pos, axis=0
+            )[:, None, :].astype(x.dtype)
+        x = x.astype(c.compute_dtype)
+
+        rope_cs = None
+        if c.position_embedding == "rope":
+            from apex_tpu.ops.rope import rope_table
+
+            # (S, 1, d/2): this step's per-slot rotation rows, gathered
+            # from the cached full table (ops/rope.py) instead of
+            # re-running the trig ladder on dynamic positions every
+            # step — the table covers the cache's whole logical extent
+            # and its rows are bit-identical to direct computation
+            # (pinned in tests/test_rope.py), so prefill and decode
+            # rotations cannot drift.  Closed over by the layer scan
+            # (same hoisting argument as _rope_tables).
+            cos_t, sin_t = rope_table(
+                page_table.shape[1] * page_size, c.head_dim,
+                base=c.rope_base)
+            rope_cs = (jnp.take(cos_t, positions, axis=0)[:, None],
+                       jnp.take(sin_t, positions, axis=0)[:, None])
+
+        attend = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+        wp, wo = write_targets(page_table, positions, active, page_size)
+        decode_impl = "xla" if c.attention_impl == "xla" else None
+
+        def body(x, scanned):
+            lp, pool_l = scanned
+            residual = x
+            y = self._norm(lp["ln1"], x).astype(c.compute_dtype)
+            q, k, v = self._qkv_heads(lp, y)      # (S, hl, 1, d)
+            if rope_cs is not None:
+                from apex_tpu.ops.rope import apply_rope_tables
+
+                k = apply_rope_tables(
+                    k, rope_cs[0][:, None], rope_cs[1][:, None])
+            pool_l = write_tokens(
+                pool_l, k[:, :, 0], v[:, :, 0], wp, wo,
+                quantized=quantized, kv_block=kv_block)
+            attn = fmha_decode(
+                q, pool_l["k"], pool_l["v"], page_table, attend,
+                causal=True, k_scales=pool_l.get("k_scales"),
+                v_scales=pool_l.get("v_scales"), kv_block=kv_block,
+                rope=rope_cs, implementation=decode_impl)
+            attn = jnp.moveaxis(attn, 1, 2).reshape(S, 1, -1)
+            out = self.attn_proj.apply(lp["attn_proj"], attn)
+            x = residual + out.astype(residual.dtype)
+            residual = x
+            y = self._norm(lp["ln2"], x).astype(c.compute_dtype)
+            y = self._dense_mlp(lp, y)
+            return residual + y.astype(residual.dtype), pool_l
+
+        x, new_pools = jax.lax.scan(body, x, (params["layers"], pools))
+        x = self._norm(params["final_ln"], x.astype(jnp.float32))
+        logits = self.logits(params, x.astype(c.compute_dtype))[:, 0]
+        return logits, new_pools
+
+    def decode_fns(
+        self,
+        params: Dict[str, Any],
+        mesh,
+        cache_config,
+        *,
+        max_prompt_len: int,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
+    ):
+        """Build the jitted ``(prefill, decode)`` step pair the
+        continuous-batching driver
+        (:class:`apex_tpu.serving.serve.ContinuousBatcher`) runs.
+
+        Both close over nothing dynamic: params ride as an argument
+        through ONE jit each, every other shape comes from
+        ``cache_config``/``max_prompt_len``, so the pair compiles once
+        for the server's lifetime.  Returns a :class:`GPTDecodeFns`
+        carrying the bound callables plus the raw jitted functions
+        (``prefill_jit``/``decode_jit``) — the seam the
+        compile-counting tests spy on.
+
+        Serving runs dp-replicated on the mesh; tensor/pipeline/
+        context-parallel decode is not implemented (the cache pools
+        would need head-sharding) and is rejected loudly."""
+        from apex_tpu.serving.kv_cache import (
+            init_pools, write_targets, write_tokens,
+        )
+        from apex_tpu.serving.sampling import sample
+        from apex_tpu.transformer import parallel_state
+        from apex_tpu._compat import shard_map
+
+        c = self.config
+        if self.moe is not None:
+            raise NotImplementedError("MoE decode is not supported")
+        if parallel_state.get_tensor_model_parallel_world_size() > 1 or \
+                parallel_state.get_pipeline_model_parallel_world_size() > 1:
+            raise NotImplementedError(
+                "serving decode is dp-replicated: initialize the mesh "
+                "with tp=pp=1 (head-sharded cache pools are future work)")
+        cfg = cache_config
+        if (cfg.num_layers != c.num_layers
+                or cfg.num_heads != c.num_attention_heads
+                or cfg.head_dim != c.head_dim):
+            raise ValueError(
+                f"cache config (L={cfg.num_layers}, h={cfg.num_heads}, "
+                f"d={cfg.head_dim}) does not match the model "
+                f"(L={c.num_layers}, h={c.num_attention_heads}, "
+                f"d={c.head_dim})")
+        if c.position_embedding == "learned" and \
+                cfg.max_len > c.max_position_embeddings:
+            raise ValueError(
+                f"cache holds up to {cfg.max_len} positions but the "
+                f"learned table stops at {c.max_position_embeddings}")
+
+        specs = self.param_specs()
+        pool_tmpl = jax.eval_shape(lambda: init_pools(cfg))
+        pool_specs = jax.tree.map(lambda _: P(), pool_tmpl)
+        rep = lambda tree: jax.tree.map(lambda _: P(), tree)
+
+        def _prefill(params, pools, toks, length, page_row, key):
+            hidden, ks, vs = self.prefill_forward(params, toks)
+            pos = jnp.arange(toks.shape[1], dtype=jnp.int32)
+            valid = pos < length
+            wp, wo = write_targets(page_row, pos, valid, cfg.page_size)
+
+            def write_layer(pool_l, kl, vl):
+                # (1, hl, s, d) -> (s, hl, d) token rows
+                return write_tokens(
+                    pool_l, jnp.moveaxis(kl[0], 1, 0),
+                    jnp.moveaxis(vl[0], 1, 0), wp, wo,
+                    quantized=cfg.quantized, kv_block=cfg.kv_block)
+
+            pools = jax.vmap(write_layer)(pools, ks, vs)
+            last = jnp.take(hidden[0], length - 1, axis=0)  # (h,)
+            logits = self.logits(params, last[None, None])[0, 0]
+            tok = sample(logits[None], key, temperature, top_k,
+                         top_p)[0]
+            return pools, tok
+
+        def _decode(params, pools, carry, page_table):
+            active = jnp.logical_not(carry["done"])
+            logits, pools = self.decode_step(
+                params, carry["tokens"], carry["lengths"], active,
+                page_table, pools, quantized=cfg.quantized,
+                kv_block=cfg.kv_block)
+            key, sub = jax.random.split(carry["key"])
+            sampled = sample(logits, sub, temperature, top_k, top_p)
+            ai = active.astype(jnp.int32)
+            tokens = jnp.where(active, sampled, carry["tokens"])
+            steps_left = carry["steps_left"] - ai
+            eos_hit = ((tokens == eos_id) if eos_id is not None
+                       else jnp.zeros_like(active))
+            done = carry["done"] | (
+                active & (eos_hit | (steps_left <= 0)))
+            return pools, {
+                "tokens": tokens,
+                "lengths": carry["lengths"] + ai,
+                "steps_left": steps_left,
+                "done": done,
+                "key": key,
+            }
+
+        from apex_tpu.serving.serve import init_carry
+
+        carry_tmpl = init_carry(cfg.max_seqs)
+        pf = jax.jit(shard_map(
+            _prefill, mesh=mesh,
+            in_specs=(specs, pool_specs, P(), P(), P(), P()),
+            out_specs=(pool_specs, P()),
+        ))
+        df = jax.jit(shard_map(
+            _decode, mesh=mesh,
+            in_specs=(specs, pool_specs, rep(carry_tmpl), P()),
+            out_specs=(pool_specs, rep(carry_tmpl)),
+        ))
+        prefill = lambda pools, toks, ln, row, key: pf(
+            params, pools, toks, ln, row, key)
+        decode = lambda pools, carry, pt: df(params, pools, carry, pt)
+        # the batcher only sees the callables; stamp the freeze id so
+        # it can reject a host truncation id the device disagrees with
+        decode.eos_id = eos_id
+        return GPTDecodeFns(
+            prefill=prefill,
+            decode=decode,
+            prefill_jit=pf,
+            decode_jit=df,
+            eos_id=eos_id,
+        )
+
+    def generate(
+        self,
+        params: Dict[str, Any],
+        prompts,
+        prompt_lengths,
+        max_new_tokens: int,
+        *,
+        mesh,
+        page_size: int = 64,
+        kv_dtype: Optional[Any] = None,
+        kv_block: int = 128,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
+        key: Optional[jax.Array] = None,
+        harvest_every: int = 8,
+        max_seqs: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        logger: Optional[Any] = None,
+    ):
+        """Generate from ``prompts (b, s)`` (right-padded; real lengths
+        in ``prompt_lengths``) through the full serving stack — paged
+        KV cache, fused decode kernel, on-device sampling, continuous
+        batching.  ``max_seqs`` (default ``b``) bounds concurrent
+        slots, so ``b > max_seqs`` exercises real admit/retire churn.
+        ``kv_dtype=jnp.int8`` stores the cache quantized.  Returns the
+        per-prompt generated token lists (EOS included when hit)."""
+        import numpy as np
+
+        from apex_tpu.serving.kv_cache import (
+            KVCacheConfig, PagedKVCache, init_pools,
+        )
+        from apex_tpu.serving.serve import ContinuousBatcher, Request
+
+        c = self.config
+        prompts = np.asarray(prompts)
+        prompt_lengths = np.asarray(prompt_lengths)
+        b, s = prompts.shape
+        max_seqs = int(max_seqs or b)
+        pages_per_seq = -(-(s + max_new_tokens) // page_size)
+        num_pages = int(num_pages
+                        or 1 + max_seqs * pages_per_seq)
+        ccfg = KVCacheConfig(
+            num_layers=c.num_layers,
+            num_heads=c.num_attention_heads,
+            head_dim=c.head_dim,
+            num_pages=num_pages,
+            page_size=page_size,
+            max_seqs=max_seqs,
+            pages_per_seq=pages_per_seq,
+            dtype=c.compute_dtype,
+            kv_dtype=kv_dtype,
+            kv_block=kv_block,
+        )
+        fns = self.decode_fns(
+            params, mesh, ccfg, max_prompt_len=s,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id)
+        batcher = ContinuousBatcher(
+            fns.prefill, fns.decode, PagedKVCache(ccfg),
+            init_pools(ccfg), max_prompt_len=s,
+            harvest_every=harvest_every, eos_id=eos_id, key=key,
+            logger=logger)
+        reqs = [
+            Request(uid=i,
+                    prompt=[int(t) for t in
+                            prompts[i, : int(prompt_lengths[i])]],
+                    max_new_tokens=max_new_tokens)
+            for i in range(b)
+        ]
+        comps = batcher.run(reqs)
+        return [comps[i].tokens for i in range(b)]
+
+    def generate_reference(
+        self,
+        params: Dict[str, Any],
+        prompts,
+        prompt_lengths,
+        max_new_tokens: int,
+        *,
+        mesh,
+    ):
+        """Naive full-recompute GREEDY reference: every step re-runs the
+        whole forward (the training attention ladder, no cache) over
+        the growing padded sequence and argmaxes the last valid
+        position.  O(steps * s^2) — exists to GATE the paged path
+        (``validate_fmha_decode`` / ``_dryrun_decode`` assert the
+        serving stack's greedy tokens match this exactly), never to
+        serve.  Learned-position models need ``s + max_new_tokens <=
+        max_position_embeddings``."""
+        import numpy as np
+
+        from apex_tpu._compat import shard_map
+
+        c = self.config
+        prompts = np.asarray(prompts)
+        prompt_lengths = np.asarray(prompt_lengths)
+        b, s = prompts.shape
+        total = s + max_new_tokens
+        if c.position_embedding == "learned" and \
+                total > c.max_position_embeddings:
+            raise ValueError(
+                f"reference needs {total} positions but the learned "
+                f"table stops at {c.max_position_embeddings}")
+        specs = self.param_specs()
+
+        def step(p, buf, lens):
+            logits = self.apply(p, buf)                    # (b, T, V/tp)
+            idx = jnp.clip(lens - 1, 0, total - 1)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]  # (b, V/tp)
+            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            buf = buf.at[jnp.arange(b), lens].set(nxt)
+            return buf, lens + 1, nxt
+
+        fn = jax.jit(shard_map(
+            step, mesh=mesh, in_specs=(specs, P(), P()),
+            out_specs=(P(), P(), P()),
+        ))
+        buf = jnp.zeros((b, total), jnp.int32)
+        buf = buf.at[:, :s].set(jnp.asarray(prompts, jnp.int32))
+        lens = jnp.asarray(prompt_lengths, jnp.int32)
+        outs = []
+        for _ in range(max_new_tokens):
+            buf, lens, nxt = fn(params, buf, lens)
+            outs.append(nxt)
+        return np.asarray(jax.device_get(jnp.stack(outs))).T  # (b, new)
 
     # ------------------------------------------------------ pipeline path
     def pipeline_param_specs(

@@ -826,7 +826,11 @@ def flash_attention(
     per-seqlen {128,256,384,512} fmha kernels), or ``"mid"`` (the
     pipelined mid-sequence kernel in ``ops/attention_mid.py``: smaller
     streamed k-blocks + batch*head packing + causal block-skipping for
-    the 512 < s <= ~2048 band); default picks by platform and the
+    the 512 < s <= ~2048 band), or ``"decode"`` (the fourth rung,
+    ``ops/attention_decode.py``: tiny-q generation attention against a
+    long cache — explicit-only, forward-only, no bias/segments/dropout;
+    serving callers with a paged cache call ``fmha_decode`` directly);
+    default picks by platform and the
     measured three-tier dispatch ladder short → mid → flash
     (crossovers ``FMHA_SHORT_MAX_SEQ`` / ``FMHA_MID_MAX_SEQ``,
     env-overridable — see ``docs/attention.md``).
@@ -890,6 +894,23 @@ def flash_attention(
             implementation="pallas" if forced else None,
         )
 
+    if implementation == "decode":
+        # the fourth rung (ops/attention_decode.py): tiny-q against a
+        # long cache, here over contiguous K/V viewed as trivially-paged
+        # storage.  Decode callers hold no trainable bias/segments and
+        # never differentiate through the cache, so the rung is
+        # explicit-only — the training ladder's measured crossovers
+        # stay untouched.  Serving callers with a real page table call
+        # fmha_decode directly.
+        if (bias is not None or q_segment_ids is not None
+                or dropout_rate > 0.0):
+            raise ValueError(
+                "implementation='decode' supports plain (optionally "
+                "causal) attention only — no bias/segments/dropout"
+            )
+        from apex_tpu.ops.attention_decode import decode_contiguous
+
+        return decode_contiguous(q, k, v, causal=causal, sm_scale=sm_scale)
     if implementation == "short":
         return _short_path(forced=True)
     if implementation == "mid":

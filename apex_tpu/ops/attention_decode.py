@@ -1,0 +1,533 @@
+"""Decode-tier attention (fmha-decode): tiny-q against a paged KV cache.
+
+The fourth rung of the measured attention ladder (short / mid / flash /
+**decode** — docs/attention.md).  The first three rungs are built for
+training shapes: s_q == s_k, both large, FLOP-bound.  Generation
+inverts every one of those assumptions — s_q is 1 (or a small
+speculative/chunked-prefill handful), s_k is the whole conversation so
+far, and the arithmetic intensity collapses to ~2 FLOPs per KV byte, so
+the kernel's job is to stream the cache at HBM bandwidth while the
+elementwise chain (RoPE rotation, online-softmax bookkeeping, the
+normalization tail) hides under the dots ("LLM Inference Acceleration
+via Efficient Operation Fusion", PAPERS.md — the same fusion discipline
+PRs 1/5/7 applied to training).
+
+Why **paged**: a serving batch holds sequences of wildly different
+lengths that grow, finish and get replaced mid-flight.  A dense
+``(b, h, max_len, d)`` cache wastes HBM on every short sequence and
+forces a copy whenever a slot is reused; a page pool
+(``apex_tpu/serving/kv_cache.py``) allocates fixed-size token pages on
+demand and maps each sequence's logical positions to physical pages
+through a small int32 table.  The kernel consumes that layout directly:
+
+- **pool layout** ``(num_pages, h, page_size, d)`` — one page holds
+  ``page_size`` consecutive tokens of ONE sequence for ALL heads, so a
+  single page DMA feeds every head's dot (the per-head trailing
+  ``(page_size, d)`` tile is Mosaic-native);
+- **scalar-prefetch page walk** — the grid is ``(b, h_blocks,
+  num_logical_pages)`` and the k/v index maps read the page table from
+  SMEM (``pltpu.PrefetchScalarGridSpec``), so the data-dependent gather
+  is a DMA address computation, never a materialized ``take``;
+- **head packing** (PR 1/PR 5's ``block_bh`` trick at decode shapes):
+  all of a sequence's heads (grouped ``block_h`` at a time) ride one
+  program and one page fetch, their tiny per-head dots issued
+  back-to-back from one unrolled body so the pipeline never drains
+  between (b, h) pairs — the s_q=1 grid that would otherwise idle the
+  VPU stays saturated;
+- **ONE kernel for fp32/bf16 and int8 pages**: int8 pools carry per
+  ``(token, kv_block)`` fp32 scales (``ops/quantization.py``'s
+  row-block machinery) and the kernel dequantizes each page in VMEM
+  right before its dot — int8 halves (vs bf16) the bytes streamed, which
+  is the whole game at decode intensity;
+- **fused RoPE**: the query rotation for the current positions happens
+  inside the kernel (``q*cos + rotate_half(q)*sin`` — the wrapper
+  ships the pre-shuffled ``rotate_half(q)`` companion so the in-kernel
+  work is pure elementwise multiply-add under the page stream; K is
+  rotated once at cache-write time and never again);
+- **partially-filled pages**: per-sequence ``lengths`` mask the tail
+  page exactly, and logical pages past a sequence's length are skipped
+  (``pl.when``) — unallocated table entries point at physical page 0,
+  so the skipped DMA is always addressable.
+
+Dispatch: serving callers hold a page table and call :func:`fmha_decode`
+directly; ``flash_attention(implementation="decode")`` routes contiguous
+``(b, h, s_k, d)`` K/V here by viewing it as trivially-paged storage
+(``page_table[b] = b*pages + arange``) — the A/B seam
+``tools/kernel_validation.py``'s ``validate_fmha_decode`` sweep times.
+There is no auto-dispatch window: decode callers know they are decoding
+(they hold a cache), and the training ladder's crossover measurements
+stay untouched.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import _NEG_INF, _interpret
+from apex_tpu.ops.common import shape_struct
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+__all__ = [
+    "fmha_decode",
+    "paged_attention_reference",
+    "decode_contiguous",
+    "FMHA_DECODE_BLOCK_H",
+]
+
+_LANES = 128
+
+#: How many heads one grid program packs (the decode analog of the
+#: short/mid kernels' block_bh): each program holds block_h heads' q
+#: resident and unrolls their per-page dots back-to-back over one page
+#: DMA.  16 matches FMHA_SHORT_MAX_BLOCK_BH's measured code-size bound.
+FMHA_DECODE_BLOCK_H = 16
+
+
+class _DecodeConfig(NamedTuple):
+    """Static kernel configuration."""
+
+    sm_scale: float
+    causal: bool
+    sq: int
+    block_h: int
+    page_size: int
+    num_pages: int      # logical pages per sequence (grid extent)
+    kv_block: int       # scale block width along d (int8 pages only)
+    has_scales: bool
+    has_rope: bool
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path (also the CPU fallback and the validation anchor)
+# ---------------------------------------------------------------------------
+
+
+def _dequant_pages(pages, scales, kv_block):
+    """(num_pages, h, page_size, d) int8 + (num_pages, h, page_size, nb)
+    fp32 scales -> fp32, per-(token, kv_block) dequantization."""
+    d = pages.shape[-1]
+    expand = jnp.repeat(scales, kv_block, axis=-1)[..., :d]
+    return pages.astype(jnp.float32) * expand
+
+
+def paged_attention_reference(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    k_scales: Optional[jnp.ndarray] = None,
+    v_scales: Optional[jnp.ndarray] = None,
+    kv_block: int = _LANES,
+) -> jnp.ndarray:
+    """Plain-XLA paged decode attention — the correctness reference.
+
+    Materializes the per-sequence gather (``take`` over the page table)
+    and computes masked softmax attention in fp32.  Query token ``i`` of
+    sequence ``b`` sits at position ``lengths[b] - sq + i`` and attends
+    to cache positions ``<= `` its own (``causal=True``) or to all
+    ``lengths[b]`` positions.  The cache is expected to already contain
+    the query tokens' own K/V (write-before-attend, so a decode token
+    attends to itself).
+    """
+    b, h, sq, d = q.shape
+    num_pages = page_table.shape[1]
+    page_size = k_pages.shape[2]
+    scale = (1.0 / d**0.5) if sm_scale is None else float(sm_scale)
+
+    def gather(pages, scales):
+        x = jnp.take(pages, page_table, axis=0)  # (b, np, h, ps, d)
+        if scales is not None:
+            s = jnp.take(scales, page_table, axis=0)
+            x = _dequant_pages(x, s, kv_block)
+        x = jnp.moveaxis(x, 2, 1)
+        return x.reshape(b, h, num_pages * page_size, d)
+
+    k = gather(k_pages, k_scales)
+    v = gather(v_pages, v_scales)
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    k_pos = jnp.arange(num_pages * page_size)[None, None, None, :]
+    if causal:
+        q_pos = (lengths[:, None, None, None] - sq
+                 + jnp.arange(sq)[None, None, :, None])
+        mask = k_pos <= q_pos
+    else:
+        mask = k_pos < lengths[:, None, None, None]
+    s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(mask, p, 0.0)
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(*refs, cfg: _DecodeConfig):
+    pt_ref, len_ref = refs[:2]
+    rest = list(refs[2:])
+    q_ref = rest.pop(0)
+    qrot_ref = cos_ref = sin_ref = None
+    if cfg.has_rope:
+        qrot_ref, cos_ref, sin_ref = rest.pop(0), rest.pop(0), rest.pop(0)
+    k_ref, v_ref = rest.pop(0), rest.pop(0)
+    ks_ref = vs_ref = None
+    if cfg.has_scales:
+        ks_ref, vs_ref = rest.pop(0), rest.pop(0)
+    o_ref, acc_ref, m_ref, l_ref = rest
+
+    b, hb, p = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    sq, ps = cfg.sq, cfg.page_size
+    ln = len_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # logical pages at or past this sequence's length hold nothing this
+    # query may attend to — skip their compute entirely (the decode
+    # analog of the mid kernel's causal block-skip; with variable
+    # lengths in a batch the grid covers the longest sequence and short
+    # ones skip the difference)
+    @pl.when(p * ps < ln)
+    def _body():
+        d = q_ref.shape[-1]
+        for hi in range(cfg.block_h):
+            qh = q_ref[0, hi].astype(jnp.float32)            # (sq, d)
+            if cfg.has_rope:
+                # q*cos + rotate_half(q)*sin: the rotation's FLOPs run
+                # in-kernel under the page stream; the half-swap data
+                # shuffle happened once in the wrapper (XLA fuses it
+                # into the q projection epilogue)
+                qh = (qh * cos_ref[0, hi].astype(jnp.float32)
+                      + qrot_ref[0, hi].astype(jnp.float32)
+                      * sin_ref[0, hi].astype(jnp.float32))
+            qh = qh * cfg.sm_scale
+            kh = k_ref[0, hi].astype(jnp.float32)            # (ps, d)
+            vh = v_ref[0, hi].astype(jnp.float32)
+            if cfg.has_scales:
+                kh = kh * jnp.repeat(
+                    ks_ref[0, hi], cfg.kv_block, axis=1)[:, :d]
+                vh = vh * jnp.repeat(
+                    vs_ref[0, hi], cfg.kv_block, axis=1)[:, :d]
+            s = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                                 # (sq, ps)
+            k_pos = p * ps + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            if cfg.causal:
+                q_pos = ln - sq + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                mask = k_pos <= q_pos
+            else:
+                mask = k_pos < ln
+            s = jnp.where(mask, s, _NEG_INF)
+            r0, r1 = hi * sq, (hi + 1) * sq
+            m_prev = m_ref[r0:r1, 0:1]
+            l_prev = l_ref[r0:r1, 0:1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            pexp = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+            corr = jnp.exp(m_prev - m_new)
+            l_new = l_prev * corr + jnp.sum(pexp, axis=-1, keepdims=True)
+            acc_ref[r0:r1] = acc_ref[r0:r1] * corr + jax.lax.dot_general(
+                pexp, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[r0:r1] = jnp.broadcast_to(m_new, (sq, m_ref.shape[1]))
+            l_ref[r0:r1] = jnp.broadcast_to(l_new, (sq, l_ref.shape[1]))
+
+    @pl.when(p == cfg.num_pages - 1)
+    def _finalize():
+        # the softmax-normalization tail, fused (the operation-fusion
+        # paper's point: this divide never round-trips through HBM).
+        # A zero-length sequence (an idle serving slot) clamps l and
+        # writes garbage the caller masks.
+        ll = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[...] / ll).reshape(o_ref.shape[1:]).astype(
+            o_ref.dtype)
+
+
+def _decode_pallas(q, q_rot, cos, sin, k_pages, v_pages, k_scales,
+                   v_scales, page_table, lengths, cfg: _DecodeConfig):
+    b, h, sq, d = q.shape
+    ps = cfg.page_size
+    nb = k_scales.shape[-1] if cfg.has_scales else 0
+    bh = cfg.block_h
+    n_hb = h // bh
+
+    def qmap(bb, hb, p, pt, ln):
+        return (bb, hb, 0, 0)
+
+    def kvmap(bb, hb, p, pt, ln):
+        return (pt[bb, p], hb, 0, 0)
+
+    in_specs = [pl.BlockSpec((1, bh, sq, d), qmap)]
+    inputs = [q]
+    if cfg.has_rope:
+        in_specs += [pl.BlockSpec((1, bh, sq, d), qmap)] * 3
+        inputs += [q_rot, cos, sin]
+    in_specs += [
+        pl.BlockSpec((1, bh, ps, d), kvmap),
+        pl.BlockSpec((1, bh, ps, d), kvmap),
+    ]
+    inputs += [k_pages, v_pages]
+    if cfg.has_scales:
+        in_specs += [
+            pl.BlockSpec((1, bh, ps, nb), kvmap),
+            pl.BlockSpec((1, bh, ps, nb), kvmap),
+        ]
+        inputs += [k_scales, v_scales]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_hb, cfg.num_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bh, sq, d), qmap),
+        scratch_shapes=[
+            pltpu.VMEM((bh * sq, d), jnp.float32),
+            pltpu.VMEM((bh * sq, _LANES), jnp.float32),
+            pltpu.VMEM((bh * sq, _LANES), jnp.float32),
+        ],
+    )
+    from apex_tpu.ops.common import tpu_compiler_params
+
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, cfg=cfg),
+        grid_spec=grid_spec,
+        out_shape=shape_struct((b, h, sq, d), q.dtype, q, k_pages,
+                               v_pages),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), *inputs)
+
+
+# ---------------------------------------------------------------------------
+# Public entry points
+# ---------------------------------------------------------------------------
+
+
+def _rotate_half(x):
+    d = x.shape[-1]
+    return jnp.concatenate([-x[..., d // 2:], x[..., : d // 2]], axis=-1)
+
+
+def _rope_operands(q, rope: Tuple[jnp.ndarray, jnp.ndarray]):
+    """Expand (cos, sin) half-tables to full-width per-(b, h, sq) planes
+    plus the rotate_half(q) companion the kernel's elementwise form
+    needs.  ``rope`` is ``(cos, sin)`` of shape ``(b, sq, d/2)`` (the
+    per-sequence decode positions, ``ops/rope.py rope_cos_sin``)."""
+    b, h, sq, d = q.shape
+    cos, sin = rope
+    if cos.shape != (b, sq, d // 2):
+        raise ValueError(
+            f"rope tables must be (b, sq, d/2) = ({b}, {sq}, {d // 2}), "
+            f"got {cos.shape}"
+        )
+    full = lambda t: jnp.broadcast_to(
+        jnp.concatenate([t, t], axis=-1)[:, None], (b, h, sq, d)
+    ).astype(jnp.float32)
+    return _rotate_half(q.astype(jnp.float32)), full(cos), full(sin)
+
+
+def _pick_block_h(h: int) -> int:
+    bh = min(h, FMHA_DECODE_BLOCK_H)
+    while h % bh:
+        bh -= 1
+    return bh
+
+
+def fmha_decode(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    k_scales: Optional[jnp.ndarray] = None,
+    v_scales: Optional[jnp.ndarray] = None,
+    kv_block: int = _LANES,
+    rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    block_h: Optional[int] = None,
+    implementation: Optional[str] = None,
+) -> jnp.ndarray:
+    """Decode attention: ``q (b, h, sq, d)`` against a paged KV cache.
+
+    ``k_pages``/``v_pages`` are the ``(num_pages, h, page_size, d)``
+    pool (fp32/bf16, or int8 with ``k_scales``/``v_scales`` per-
+    ``(token, kv_block)`` fp32 scales of shape ``(num_pages, h,
+    page_size, ceil(d/kv_block))`` — ``serving/kv_cache.py`` writes
+    both layouts).  ``page_table (b, logical_pages)`` maps each
+    sequence's logical page to a physical pool page (unallocated
+    entries MUST hold a valid index — the allocator's reserved null
+    page 0); ``lengths (b,)`` counts valid tokens per sequence
+    INCLUDING the query tokens (write-before-attend: a decode token
+    attends to itself).
+
+    ``sq`` is 1 for plain decode; small ``sq > 1`` serves speculative
+    verification and chunked prefill, with ``causal=True`` masking each
+    query token at its own position ``lengths[b] - sq + i``.  ``rope``
+    fuses the query-side rotation for those positions into the kernel
+    (K is rotated at cache-write time).  Forward-only by design — the
+    generation loop never differentiates through the cache.
+
+    ``implementation``: None = platform default (Pallas on TPU, XLA
+    reference otherwise), ``"pallas"`` strict, ``"xla"`` reference.
+    """
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("int8 pages need BOTH k_scales and v_scales")
+    if k_pages.dtype == jnp.int8 and k_scales is None:
+        raise ValueError("int8 pages require k_scales/v_scales")
+    if k_pages.dtype != jnp.int8 and k_scales is not None:
+        raise ValueError(
+            f"scales passed with {k_pages.dtype} pages — scales belong "
+            "to int8 pools only (stale scales would silently rescale "
+            "full-precision K/V)")
+    if q.shape[1] != k_pages.shape[1]:
+        raise ValueError(
+            f"q heads {q.shape[1]} != pool heads {k_pages.shape[1]}"
+        )
+    if q.shape[-1] != k_pages.shape[-1]:
+        raise ValueError(
+            f"q head_dim {q.shape[-1]} != pool head_dim "
+            f"{k_pages.shape[-1]}"
+        )
+    if page_table.ndim != 2 or page_table.shape[0] != q.shape[0]:
+        raise ValueError(
+            f"page_table must be (batch, logical_pages), got "
+            f"{page_table.shape} for batch {q.shape[0]}"
+        )
+    b, h, sq, d = q.shape
+    if block_h is not None and h % int(block_h):
+        raise ValueError(f"block_h {block_h} must divide heads {h}")
+    if rope is not None and rope[0].shape != (b, sq, d // 2):
+        raise ValueError(
+            f"rope tables must be (b, sq, d/2) = ({b}, {sq}, {d // 2}), "
+            f"got {rope[0].shape}"
+        )
+    scale = (1.0 / d**0.5) if sm_scale is None else float(sm_scale)
+
+    from apex_tpu.ops.common import KernelLoweringError, run_kernel
+    from apex_tpu.utils.platform import default_implementation
+
+    if implementation not in (None, "pallas", "xla", "decode"):
+        raise ValueError(
+            f"unknown implementation {implementation!r}; expected None, "
+            "'pallas'/'decode', or 'xla'"
+        )
+    if implementation == "decode":
+        implementation = "pallas"
+    if pl is None and implementation == "pallas":
+        raise KernelLoweringError(
+            "implementation='pallas' requested but Pallas failed to import"
+        )
+    impl = implementation or default_implementation()
+    if pl is None:
+        impl = "xla"
+
+    def _xla_path():
+        qq = q
+        if rope is not None:
+            from apex_tpu.ops.rope import apply_rope_tables
+
+            qq = apply_rope_tables(q, rope[0][:, None], rope[1][:, None])
+        return paged_attention_reference(
+            qq, k_pages, v_pages, page_table, lengths, causal=causal,
+            sm_scale=scale, k_scales=k_scales, v_scales=v_scales,
+            kv_block=kv_block,
+        )
+
+    def _pallas_path():
+        bh = _pick_block_h(h) if block_h is None else int(block_h)
+        if h % bh:
+            raise ValueError(f"block_h {bh} must divide heads {h}")
+        cfg = _DecodeConfig(
+            sm_scale=scale, causal=causal, sq=sq, block_h=bh,
+            page_size=k_pages.shape[2], num_pages=page_table.shape[1],
+            kv_block=int(kv_block), has_scales=k_scales is not None,
+            has_rope=rope is not None,
+        )
+        q_rot = cos = sin = None
+        if rope is not None:
+            q_rot, cos, sin = _rope_operands(q, rope)
+        return _decode_pallas(
+            q, q_rot, cos, sin, k_pages, v_pages, k_scales, v_scales,
+            page_table, lengths, cfg,
+        )
+
+    return run_kernel(
+        "fmha_decode", _pallas_path, _xla_path, implementation, impl
+    )
+
+
+def decode_contiguous(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    page_size: int = 128,
+    implementation: Optional[str] = None,
+) -> jnp.ndarray:
+    """Run :func:`fmha_decode` over CONTIGUOUS ``(b, h, s_k, d)`` K/V by
+    viewing it as trivially-paged storage — the
+    ``flash_attention(implementation="decode")`` seam, and the A/B
+    comparator ``validate_fmha_decode`` times against the XLA reference.
+
+    ``causal=True`` requires ``sq <= sk`` and places query token ``i``
+    at position ``sk - sq + i`` (the decode convention: the cache's
+    tail IS the query window — for ``sq == sk`` this is exactly the
+    training ladder's causal mask).
+    """
+    b, h, sk, d = k.shape
+    sq = q.shape[2]
+    if causal and sq > sk:
+        raise ValueError(
+            f"decode causal needs sq <= sk (query positions are the "
+            f"cache tail), got sq={sq} sk={sk}"
+        )
+    ps = min(page_size, sk)
+    pad = (-sk) % ps
+    if pad:
+        padw = ((0, 0), (0, 0), (0, pad), (0, 0))
+        k, v = jnp.pad(k, padw), jnp.pad(v, padw)
+    num_pages = (sk + pad) // ps
+    # (b, h, np*ps, d) -> (b*np, h, ps, d): sequence b's logical page p
+    # is physical page b*np + p
+    pagify = lambda x: jnp.moveaxis(
+        x.reshape(b, h, num_pages, ps, d), 2, 1
+    ).reshape(b * num_pages, h, ps, d)
+    page_table = (
+        jnp.arange(b, dtype=jnp.int32)[:, None] * num_pages
+        + jnp.arange(num_pages, dtype=jnp.int32)[None, :]
+    )
+    lengths = jnp.full((b,), sk, jnp.int32)
+    return fmha_decode(
+        q, pagify(k), pagify(v), page_table, lengths, causal=causal,
+        sm_scale=sm_scale, implementation=implementation,
+    )

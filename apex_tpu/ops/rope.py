@@ -19,11 +19,15 @@ and the rotation is applied in fp32 then cast back.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
-__all__ = ["rope_cos_sin", "apply_rope", "apply_rope_tables"]
+__all__ = [
+    "rope_cos_sin", "apply_rope", "apply_rope_tables", "rope_table",
+    "apply_rope_at",
+]
 
 
 def rope_cos_sin(
@@ -77,3 +81,84 @@ def apply_rope_tables(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
     )
     return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Incremental decode: position-indexed application + cached tables
+# ---------------------------------------------------------------------------
+
+#: (max_len, head_dim, dtype_name, base) -> (cos, sin) tables.  Decode
+#: calls rotate ONE position per sequence per step; recomputing the
+#: trig ladder every step would put an iota+cos+sin chain in front of
+#: every cache write, so the full table is built once per
+#: (max_len, dim, dtype) and the per-step work is a row gather.
+_TABLE_CACHE: dict = {}
+
+
+def rope_table(
+    max_len: int, head_dim: int, dtype: Any = jnp.float32,
+    base: float = 10000.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Cached ``(cos, sin)`` tables of shape ``(max_len, head_dim//2)``,
+    keyed by ``(max_len, head_dim, dtype, base)``.  Rows are computed by
+    the same formula :func:`rope_cos_sin` evaluates, so gathering row
+    ``p`` is BIT-identical to computing position ``p`` directly (the
+    incremental-vs-full-sequence identity tests/test_rope.py pins).
+
+    ``dtype`` below fp32 trades table bytes for the documented >2k-
+    position drift (module docstring) — fp32 is the default for a
+    reason."""
+    key = (int(max_len), int(head_dim), jnp.dtype(dtype).name,
+           float(base))
+    hit = _TABLE_CACHE.get(key)
+    if hit is None:
+        # eager even under an active jit trace (GPTModel.decode_step
+        # calls this while being traced): without the escape the cached
+        # values would be TRACERS, poisoning every later trace that
+        # reads the cache (UnexpectedTracerError)
+        with jax.ensure_compile_time_eval():
+            cos, sin = rope_cos_sin(
+                jnp.arange(max_len, dtype=jnp.int32), head_dim, base
+            )
+            hit = (cos.astype(dtype), sin.astype(dtype))
+        _TABLE_CACHE[key] = hit
+    return hit
+
+
+def apply_rope_at(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    *,
+    base: float = 10000.0,
+    max_len: Optional[int] = None,
+    tables: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+) -> jnp.ndarray:
+    """Rotate ``x`` at ARBITRARY per-sequence positions — the
+    incremental-decode entry: each serving slot sits at its own offset
+    and advances one position per step, so the full-sequence
+    ``apply_rope`` (whole-table recompute, shared positions) does not
+    fit.
+
+    ``positions`` is ``(s,)`` (shared across the batch, any ``x``
+    layout ``(..., s, d)``) or ``(b, s)`` (per-sequence, ``x`` then
+    ``(b, h, s, d)``).  Tables come from ``tables=`` or the
+    :func:`rope_table` cache when ``max_len`` is given; with neither,
+    the trig is computed directly for just these positions
+    (:func:`rope_cos_sin`) — all three sources are bit-identical."""
+    d = x.shape[-1]
+    positions = jnp.asarray(positions)
+    if tables is None and max_len is not None:
+        tables = rope_table(max_len, d, base=base)
+    if tables is not None:
+        cos = jnp.take(tables[0], positions, axis=0).astype(jnp.float32)
+        sin = jnp.take(tables[1], positions, axis=0).astype(jnp.float32)
+    else:
+        cos, sin = rope_cos_sin(positions, d, base)
+    if positions.ndim == 2:
+        if x.ndim != 4:
+            raise ValueError(
+                f"per-sequence (b, s) positions need x of shape "
+                f"(b, h, s, d), got {x.shape}"
+            )
+        cos, sin = cos[:, None], sin[:, None]   # broadcast over heads
+    return apply_rope_tables(x, cos, sin)

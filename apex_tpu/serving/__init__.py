@@ -1,0 +1,57 @@
+"""apex_tpu.serving — the inference stack above the decode kernel.
+
+The "millions of users, heavy traffic" half of the north star: the
+training side produces a checkpoint, this package generates tokens from
+it at hardware speed.  Three modules, one layer each:
+
+- :mod:`~apex_tpu.serving.kv_cache` — the paged KV cache: a
+  preallocated page pool, a host-side free-list allocator with
+  per-sequence logical→physical page tables, and shape-stable device
+  scatters for the per-token writes; ``kv_dtype=jnp.int8`` stores
+  pages block-quantized (halved HBM stream at decode's ~2 FLOPs/byte).
+- :mod:`~apex_tpu.serving.sampling` — fused on-device
+  greedy/temperature/top-k/top-p sampling: sampled ids feed the next
+  step's embedding directly, no per-token host sync (the PR 6
+  async-harvest discipline applied to decode).
+- :mod:`~apex_tpu.serving.serve` — the continuous-batching driver:
+  admit/retire requests per step into fixed-shape slots so the decode
+  step compiles once; prefill runs the training attention ladder,
+  decode runs :func:`~apex_tpu.ops.attention_decode.fmha_decode`.
+
+The model side (``GPTModel.decode_fns`` / ``GPTModel.generate``) builds
+the step functions this package drives.  docs/serving.md is the guide.
+"""
+
+_LAZY_ATTRS = {
+    "kv_cache": "apex_tpu.serving.kv_cache",
+    "sampling": "apex_tpu.serving.sampling",
+    "serve": "apex_tpu.serving.serve",
+    "KVCacheConfig": "apex_tpu.serving.kv_cache",
+    "PageAllocator": "apex_tpu.serving.kv_cache",
+    "PagedKVCache": "apex_tpu.serving.kv_cache",
+    "CacheOutOfPages": "apex_tpu.serving.kv_cache",
+    "init_pools": "apex_tpu.serving.kv_cache",
+    "write_tokens": "apex_tpu.serving.kv_cache",
+    "greedy": "apex_tpu.serving.sampling",
+    "sample": "apex_tpu.serving.sampling",
+    "Request": "apex_tpu.serving.serve",
+    "Completion": "apex_tpu.serving.serve",
+    "ContinuousBatcher": "apex_tpu.serving.serve",
+    "init_carry": "apex_tpu.serving.serve",
+}
+
+__all__ = sorted(_LAZY_ATTRS)
+
+
+def __getattr__(name):
+    if name in _LAZY_ATTRS:
+        import importlib
+
+        mod = importlib.import_module(_LAZY_ATTRS[name])
+        val = (mod if name in ("kv_cache", "sampling", "serve")
+               else getattr(mod, name))
+        globals()[name] = val
+        return val
+    raise AttributeError(
+        f"module 'apex_tpu.serving' has no attribute {name!r}"
+    )

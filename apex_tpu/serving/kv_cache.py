@@ -1,0 +1,329 @@
+"""Paged KV cache: a page-table block allocator over a preallocated pool.
+
+Serving holds one KV entry per (layer, head, past token) for every live
+sequence, and the sequences are ragged, growing, and replaced
+mid-flight.  The dense answer — ``(slots, layers, heads, max_len, d)``
+— sizes every slot for the longest conversation the server will ever
+see; vLLM-style paging sizes the pool for the TRAFFIC instead: a single
+preallocated pool of fixed ``page_size``-token pages, a per-slot
+logical→physical page table, and a host-side free-list allocator.
+A request holds exactly ``ceil((prompt + budget) / page_size)`` pages
+and returns them on retirement; nothing is ever copied or compacted.
+
+Split of responsibilities:
+
+- **host side** (:class:`PageAllocator`, :class:`PagedKVCache`):
+  allocation, free-list reuse, the page-table and length mirrors.
+  Pure Python, no device sync — tables ship to the device as small
+  int32 arrays each step.
+- **device side** (:func:`init_pools`, :func:`write_tokens`): the
+  pools themselves and the jit-friendly scatter that writes new tokens
+  at ``(physical_page, offset)`` — shape-stable for any batch, so the
+  decode step never recompiles as sequences come and go.
+
+Physical page 0 is RESERVED as the null page: unallocated page-table
+entries (and the write targets of idle slots) point at it, so every
+address the decode kernel's scalar-prefetch walk can form is valid and
+garbage lands where nothing reads it
+(:mod:`apex_tpu.ops.attention_decode`).
+
+``kv_dtype=jnp.int8`` stores pages quantized with per-``(token,
+kv_block)`` fp32 scales (``ops/quantization.py``'s row-block
+machinery — the EQuARX block format applied to storage instead of
+wire).  The decode kernel dequantizes pages in VMEM; at decode's ~2
+FLOPs/byte arithmetic intensity the halved (vs bf16) HBM stream is the
+throughput win, and the tolerance band is gated in
+``tests/test_attention_decode.py`` and the ``_dryrun_decode`` config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "KVCacheConfig",
+    "CacheOutOfPages",
+    "PageAllocator",
+    "PagedKVCache",
+    "init_pools",
+    "write_tokens",
+    "write_targets",
+]
+
+
+class CacheOutOfPages(RuntimeError):
+    """The pool has fewer free pages than an admission needs.  The
+    serving driver treats this as backpressure (the request waits in
+    the queue), not an error."""
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Shape and dtype of one paged cache.
+
+    ``num_pages`` counts PHYSICAL pool pages (page 0 is the reserved
+    null page, so ``num_pages - 1`` are allocatable).  ``max_seqs`` is
+    the fixed slot count of the serving batch; ``pages_per_seq`` bounds
+    one sequence's logical length at ``pages_per_seq * page_size``
+    tokens.  ``kv_dtype=None`` stores pages in ``dtype``;
+    ``jnp.int8`` stores quantized pages with per-``(token, kv_block)``
+    fp32 scales."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    num_pages: int
+    page_size: int = 64
+    max_seqs: int = 8
+    pages_per_seq: int = 16
+    dtype: Any = jnp.bfloat16
+    kv_dtype: Optional[Any] = None
+    kv_block: int = 128
+
+    def __post_init__(self):
+        if self.num_pages < 2:
+            raise ValueError(
+                "num_pages must be >= 2 (page 0 is the reserved null "
+                "page)")
+        if self.page_size < 1 or self.pages_per_seq < 1:
+            raise ValueError("page_size and pages_per_seq must be >= 1")
+        if self.kv_dtype is not None and \
+                jnp.dtype(self.kv_dtype) != jnp.dtype(jnp.int8):
+            raise ValueError(
+                f"kv_dtype must be None or int8, got {self.kv_dtype!r}")
+
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype is not None
+
+    @property
+    def scale_blocks(self) -> int:
+        return -(-self.head_dim // self.kv_block)
+
+    @property
+    def max_len(self) -> int:
+        return self.page_size * self.pages_per_seq
+
+    def tokens_to_pages(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+
+# ---------------------------------------------------------------------------
+# Host side: allocator + per-slot bookkeeping
+# ---------------------------------------------------------------------------
+
+
+class PageAllocator:
+    """Free-list page allocator.  Page 0 is never handed out.
+
+    Invariants (tests/test_serving.py): a page is owned by at most one
+    caller at a time; ``free`` rejects pages not currently allocated
+    (double-free) and page 0; freed pages are reusable immediately —
+    the free list is LIFO, so a hot slot's pages stay cache-warm."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._allocated: set = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """``n`` pages, or :class:`CacheOutOfPages` — all-or-nothing,
+        so a failed admission never leaks a partial allocation."""
+        if n > len(self._free):
+            raise CacheOutOfPages(
+                f"need {n} pages, {len(self._free)} free "
+                f"(pool {self.num_pages}, 1 reserved)")
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def free(self, pages) -> None:
+        for p in pages:
+            p = int(p)
+            if p == 0:
+                raise ValueError("page 0 is the reserved null page")
+            if p not in self._allocated:
+                raise ValueError(f"page {p} is not allocated "
+                                 "(double free?)")
+            self._allocated.remove(p)
+            self._free.append(p)
+
+
+class PagedKVCache:
+    """Host-side view of one serving cache: the allocator plus the
+    page-table and length mirrors the driver ships to the device each
+    step.  Device pools live separately (:func:`init_pools`) — they are
+    step-function state, threaded through jit; this object is the
+    bookkeeping that decides WHERE in those pools each slot writes."""
+
+    def __init__(self, config: KVCacheConfig):
+        self.config = config
+        self.allocator = PageAllocator(config.num_pages)
+        self.page_table = np.zeros(
+            (config.max_seqs, config.pages_per_seq), np.int32)
+        self.lengths = np.zeros((config.max_seqs,), np.int32)
+        self._slot_pages: Dict[int, List[int]] = {}
+
+    def admit(self, slot: int, total_tokens: int) -> None:
+        """Reserve pages for a sequence of up to ``total_tokens``
+        (prompt + generation budget) in ``slot``.  Raises
+        :class:`CacheOutOfPages` (backpressure) without side effects;
+        a previously retired slot's row is guaranteed null-paged."""
+        cfg = self.config
+        if slot in self._slot_pages:
+            raise ValueError(f"slot {slot} is already admitted")
+        if total_tokens > cfg.max_len:
+            raise ValueError(
+                f"sequence of {total_tokens} tokens exceeds the slot "
+                f"bound {cfg.max_len} (pages_per_seq * page_size)")
+        pages = self.allocator.alloc(cfg.tokens_to_pages(total_tokens))
+        self._slot_pages[slot] = pages
+        row = np.zeros((cfg.pages_per_seq,), np.int32)
+        row[: len(pages)] = pages
+        self.page_table[slot] = row
+        self.lengths[slot] = 0
+
+    def retire(self, slot: int) -> None:
+        """Return the slot's pages to the pool and null its table row
+        (so a stale read through the old row hits the null page, never
+        another request's data)."""
+        pages = self._slot_pages.pop(slot)
+        self.allocator.free(pages)
+        self.page_table[slot] = 0
+        self.lengths[slot] = 0
+
+    def active_slots(self) -> List[int]:
+        return sorted(self._slot_pages)
+
+    def device_tables(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(page_table, lengths) as device arrays — a few KB per step."""
+        return (jnp.asarray(self.page_table),
+                jnp.asarray(self.lengths))
+
+
+# ---------------------------------------------------------------------------
+# Device side: pools + the token scatter
+# ---------------------------------------------------------------------------
+
+
+def init_pools(config: KVCacheConfig) -> Dict[str, jnp.ndarray]:
+    """Zeroed device pools: ``k``/``v`` of shape ``(num_layers,
+    num_pages, num_heads, page_size, head_dim)`` (the decode kernel's
+    pool layout with a leading layer axis the model's layer scan
+    slices), plus fp32 ``k_scales``/``v_scales`` when quantized."""
+    cfg = config
+    shape = (cfg.num_layers, cfg.num_pages, cfg.num_heads,
+             cfg.page_size, cfg.head_dim)
+    dt = cfg.kv_dtype if cfg.quantized else cfg.dtype
+    pools = {
+        "k": jnp.zeros(shape, dt),
+        "v": jnp.zeros(shape, dt),
+    }
+    if cfg.quantized:
+        sshape = shape[:-1] + (cfg.scale_blocks,)
+        pools["k_scales"] = jnp.ones(sshape, jnp.float32)
+        pools["v_scales"] = jnp.ones(sshape, jnp.float32)
+    return pools
+
+
+def write_targets(
+    page_table: jnp.ndarray,
+    positions: jnp.ndarray,
+    valid: jnp.ndarray,
+    page_size: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Physical ``(pages, offsets)`` for token ``positions``.
+
+    ``page_table`` is one slot's row ``(pages_per_seq,)`` (prefill:
+    ``positions`` are the prompt's ``(n,)`` token indices) or the full
+    ``(slots, pages_per_seq)`` table (decode: ``positions[i]`` is slot
+    ``i``'s current position).  Invalid entries (padding, idle slots)
+    are redirected to the null page; a position past the slot's last
+    logical page clamps (jax gather semantics) — by construction that
+    only happens to finished slots decoding out a harvest window, whose
+    writes are garbage by contract."""
+    positions = positions.astype(jnp.int32)
+    idx = positions // page_size
+    if page_table.ndim == 1:
+        phys = jnp.take(page_table, idx)
+    else:
+        phys = jnp.take_along_axis(page_table, idx[:, None], axis=1)[:, 0]
+    zero = jnp.zeros_like(phys)
+    return (
+        jnp.where(valid, phys, zero).astype(jnp.int32),
+        jnp.where(valid, positions % page_size, zero).astype(jnp.int32),
+    )
+
+
+def write_tokens(
+    layer_pools: Dict[str, jnp.ndarray],
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pages: jnp.ndarray,
+    offsets: jnp.ndarray,
+    *,
+    quantized: bool = False,
+    kv_block: int = 128,
+) -> Dict[str, jnp.ndarray]:
+    """Scatter ``n`` new tokens into ONE layer's pools.
+
+    ``layer_pools``: ``{"k", "v"[, "k_scales", "v_scales"]}`` with the
+    layer axis already sliced off (``(num_pages, h, page_size, d)``).
+    ``k_new``/``v_new``: ``(n, h, d)`` token rows — a decode step's one
+    token per slot (``n = slots``) or a prefill's whole prompt
+    (``n = prompt_len``).  ``pages``/``offsets``: ``(n,)`` int32
+    physical targets (idle or padded entries point at the null page 0).
+    Shape-stable and pure — jit it once; duplicate targets (only ever
+    the null page) resolve last-writer-wins, which is exactly what a
+    garbage page wants.
+
+    K is expected "attention-ready" (RoPE already applied): the decode
+    kernel rotates only q, so a cached key is rotated exactly once, at
+    write time."""
+    pages = pages.astype(jnp.int32)
+    offsets = offsets.astype(jnp.int32)
+    # the flag must agree with the pools' own layout: astype-truncating
+    # float K/V into int8 pages while fmha_decode keeps dequantizing
+    # with the stale scales would be silent garbage attention
+    if quantized != ("k_scales" in layer_pools):
+        raise ValueError(
+            f"quantized={quantized} but the pools "
+            f"{'carry' if 'k_scales' in layer_pools else 'lack'} "
+            "k_scales/v_scales — pass quantized=config.quantized "
+            "for the config that built these pools")
+    out = dict(layer_pools)
+    if quantized:
+        from apex_tpu.ops.quantization import quantize_rows
+
+        n, h, d = k_new.shape
+
+        def quant(x):
+            vals, scales = quantize_rows(
+                x.reshape(n * h, d).astype(jnp.float32), kv_block)
+            return (vals.reshape(n, h, d),
+                    scales.reshape(n, h, -1))
+
+        kq, ks = quant(k_new)
+        vq, vs = quant(v_new)
+        out["k"] = out["k"].at[pages, :, offsets, :].set(
+            kq.astype(out["k"].dtype))
+        out["v"] = out["v"].at[pages, :, offsets, :].set(
+            vq.astype(out["v"].dtype))
+        out["k_scales"] = out["k_scales"].at[pages, :, offsets, :].set(ks)
+        out["v_scales"] = out["v_scales"].at[pages, :, offsets, :].set(vs)
+    else:
+        out["k"] = out["k"].at[pages, :, offsets, :].set(
+            k_new.astype(out["k"].dtype))
+        out["v"] = out["v"].at[pages, :, offsets, :].set(
+            v_new.astype(out["v"].dtype))
+    return out

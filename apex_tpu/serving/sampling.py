@@ -1,0 +1,95 @@
+"""Fused on-device token sampling: greedy / temperature / top-k / top-p.
+
+The per-token host round-trip is the decode-loop analog of the per-step
+``float(loss)`` sync PR 6 removed from the trainers: sampling on the
+host would serialize every generated token behind a device→host→device
+bounce.  Everything here is pure ``jnp`` running INSIDE the jitted
+decode step — the sampled ids stay on device, feed the next step's
+embedding lookup directly, and reach the host only at the serving
+driver's harvest cadence (``serve.py``), a batched transfer amortized
+over the whole window.
+
+The chain is one fused elementwise pass over the logits (the
+operation-fusion discipline again — no intermediate materializes):
+temperature scale → top-k floor → top-p (nucleus) floor → Gumbel-max
+draw.  ``temperature=0`` short-circuits to pure argmax, and the greedy
+path is BIT-identical to ``jnp.argmax`` (tests/test_serving.py pins it
+— the ``_dryrun_decode`` greedy-parity gate depends on that).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["greedy", "sample"]
+
+_NEG_INF = -1e30
+
+
+def greedy(logits: jnp.ndarray) -> jnp.ndarray:
+    """Argmax over the last axis, int32.  THE greedy definition — the
+    sampling chain below routes ``temperature=0`` here, so "greedy
+    sampling" and "argmax" cannot drift apart."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _top_k_floor(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mask everything below the k-th largest logit.  Ties AT the
+    threshold all survive (the draw then splits them) — cheaper than a
+    strict-k tie-break and distributionally identical for continuous
+    logits."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits >= kth, logits, _NEG_INF)
+
+
+def _top_p_floor(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus floor: keep the smallest prefix of the
+    descending-probability ordering whose mass reaches ``p`` (the
+    crossing token included, so at least the argmax always survives)."""
+    sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < p
+    thresh = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    ).astype(logits.dtype)
+    return jnp.where(logits >= thresh, logits, _NEG_INF)
+
+
+def sample(
+    logits: jnp.ndarray,
+    key: Optional[jnp.ndarray] = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jnp.ndarray:
+    """One token id per row of ``logits (..., vocab)``, int32, on
+    device.
+
+    ``temperature=0`` (the default) is greedy and ignores
+    ``key``/``top_k``/``top_p``.  Otherwise logits are scaled by
+    ``1/temperature``, floored by ``top_k`` and/or ``top_p``, and drawn
+    by Gumbel-max (``argmax(logits + G)`` — one fused pass, no explicit
+    softmax or cumulative inversion on the hot path).
+    """
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if temperature == 0.0:
+        return greedy(logits)
+    if key is None:
+        raise ValueError("temperature > 0 requires a PRNG key")
+    x = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k < logits.shape[-1]:
+        x = _top_k_floor(x, int(top_k))
+    if top_p is not None and top_p < 1.0:
+        x = _top_p_floor(x, float(top_p))
+    g = jax.random.gumbel(key, x.shape, jnp.float32)
+    # floored entries sit at -1e30; a Gumbel draw cannot bridge that
+    return jnp.argmax(x + g, axis=-1).astype(jnp.int32)

@@ -1,0 +1,339 @@
+"""Continuous batching: admit/retire requests per step into fixed-shape
+slots, so the decode step compiles ONCE and never again.
+
+The driver's contract with XLA is the whole design: every device
+computation it issues — the prefill step and the decode step — has a
+single static shape (``max_seqs`` slots, ``max_prompt_len`` prompt
+window, one paged cache), and request churn only changes CONTENTS
+(page-table rows, length counters, per-slot budgets).  Admissions and
+retirements therefore cost a few small host→device transfers, never a
+recompile — ``tests/test_serving.py`` proves it with a compile-counting
+spy across three request generations.
+
+Loop anatomy (:meth:`ContinuousBatcher.run`):
+
+1. **admit** — while a slot is free, a request is queued, and the page
+   allocator has room (``CacheOutOfPages`` is backpressure, not an
+   error): reserve pages for prompt + budget, run the prefill step
+   (the TRAINING attention ladder over the padded prompt — prefill is
+   a compute-bound s_q == s_k problem, exactly what rungs 1–3 are
+   measured for), which writes the prompt's K/V into the slot's pages
+   and samples the first token.
+2. **decode** — a window of ``harvest_every`` fused decode steps.  The
+   per-slot state (current token, length, budget, done flag, PRNG key)
+   lives ON DEVICE and the step updates it functionally: sampled ids
+   feed the next embedding lookup directly, finished slots freeze
+   (their writes target the null page), nothing touches the host.
+3. **harvest** — ONE batched ``device_get`` per window (the PR 6
+   async-harvest discipline applied to decode: the window's token
+   stack and the admit-time first-token futures resolve together).
+   The host then truncates each slot's stream at EOS/budget, retires
+   finished slots (pages return to the pool), and goes back to 1.
+
+The trade is explicit: a slot that finishes mid-window decodes garbage
+until the window closes (bounded by ``harvest_every``, and its writes
+stay inside its own reserved pages), in exchange for a decode loop with
+zero per-token host syncs.  Time-to-first-token is likewise quantized
+to the harvest cadence — ``harvest_every=1`` recovers per-step
+reporting at per-step sync cost, the same knob ``MetricsLogger``'s
+``flush_every`` is.
+
+Telemetry: ``tlm.prefill`` / ``tlm.decode`` phase scopes wrap the
+dispatches, and ``span`` / ``request_admitted`` / ``request_done``
+events (with TTFT and per-window token counts) land in the metrics
+stream — ``tools/metrics_report.py``'s serving section reads them.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.serving.kv_cache import CacheOutOfPages, PagedKVCache
+from apex_tpu.telemetry.spans import phase
+
+__all__ = ["Request", "Completion", "ContinuousBatcher", "init_carry"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.  ``prompt`` is token ids; generation
+    stops after ``max_new_tokens`` or at the server's ``eos_id``."""
+
+    uid: Any
+    prompt: Sequence[int]
+    max_new_tokens: int
+
+    def __post_init__(self):
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(self.prompt) < 1:
+            raise ValueError("prompt must be non-empty")
+
+
+@dataclasses.dataclass
+class Completion:
+    """``tokens`` are the generated ids (EOS included when hit)."""
+
+    uid: Any
+    tokens: List[int]
+    prompt_len: int
+    reason: str                 # "eos" | "budget"
+    ttft_s: Optional[float] = None
+    duration_s: Optional[float] = None
+
+
+def init_carry(max_seqs: int, key: Optional[jnp.ndarray] = None
+               ) -> Dict[str, jnp.ndarray]:
+    """The decode step's per-slot device state: all slots idle."""
+    s = max_seqs
+    return {
+        "tokens": jnp.zeros((s,), jnp.int32),
+        "lengths": jnp.zeros((s,), jnp.int32),
+        "steps_left": jnp.zeros((s,), jnp.int32),
+        "done": jnp.ones((s,), bool),
+        "key": key if key is not None else jax.random.PRNGKey(0),
+    }
+
+
+class ContinuousBatcher:
+    """Drive prefill/decode step functions over a paged cache.
+
+    ``prefill_fn(pools, tokens (1, max_prompt_len) i32, length () i32,
+    page_row (pages_per_seq,) i32, key) -> (pools, first_token ()
+    i32)`` — writes the prompt's K/V and samples the first token (the
+    key is a per-admission fold of the batcher's base key; greedy
+    servers ignore it).
+
+    ``decode_fn(pools, carry, page_table (max_seqs, pages_per_seq) i32)
+    -> (pools, carry)`` — one token for every live slot; must freeze
+    slots whose ``done`` is set (null-page writes, unchanged token /
+    length / budget) and maintain ``done |= sampled == eos or budget
+    exhausted``.  :func:`apex_tpu.models.gpt.GPTModel.decode_fns`
+    builds the canonical pair.
+
+    Both are expected to be jitted ONCE outside; the driver never
+    changes a shape.  ``logger`` is an optional
+    :class:`~apex_tpu.telemetry.MetricsLogger` for span/request events.
+    """
+
+    def __init__(
+        self,
+        prefill_fn: Callable,
+        decode_fn: Callable,
+        cache: PagedKVCache,
+        pools: Dict[str, jnp.ndarray],
+        *,
+        max_prompt_len: int,
+        harvest_every: int = 8,
+        eos_id: Optional[int] = None,
+        key: Optional[jnp.ndarray] = None,
+        logger: Optional[Any] = None,
+    ):
+        if harvest_every < 1:
+            raise ValueError("harvest_every must be >= 1")
+        # the device step freezes slots at ITS eos id; the host
+        # truncates at THIS one.  A decode_fn that declares its freeze
+        # id (GPTModel.decode_fns stamps decode.eos_id) must agree, or
+        # frozen slots would replay their EOS token every harvest step
+        # while the host keeps appending it.
+        _unset = object()
+        fn_eos = getattr(decode_fn, "eos_id", _unset)
+        if fn_eos is not _unset and fn_eos != eos_id:
+            raise ValueError(
+                f"eos_id mismatch: decode_fn freezes slots at "
+                f"{fn_eos!r} but the batcher truncates at {eos_id!r} — "
+                "pass the same eos_id to decode_fns() and "
+                "ContinuousBatcher()")
+        self.prefill_fn = prefill_fn
+        self.decode_fn = decode_fn
+        self.cache = cache
+        self.pools = pools
+        self.max_prompt_len = int(max_prompt_len)
+        self.harvest_every = int(harvest_every)
+        self.eos_id = eos_id
+        self.logger = logger
+        self.carry = init_carry(cache.config.max_seqs, key)
+        self._base_key = (key if key is not None
+                          else jax.random.PRNGKey(0))
+        self._n_admits = 0
+        self._meta: Dict[int, dict] = {}      # slot -> request meta
+        self._first_tok: Dict[int, jnp.ndarray] = {}
+        self.completions: Dict[Any, Completion] = {}
+        self.steps = 0
+        self.windows = 0
+
+    # ------------------------------------------------------------ events
+    def _event(self, kind: str, **fields) -> None:
+        if self.logger is not None:
+            self.logger.event(kind, **fields)
+
+    # ------------------------------------------------------------- admit
+    def _admit(self, queue) -> None:
+        cfg = self.cache.config
+        free = [s for s in range(cfg.max_seqs) if s not in self._meta]
+        for slot in free:
+            if not queue:
+                break
+            req = queue[0]
+            plen = len(req.prompt)
+            if plen > self.max_prompt_len:
+                raise ValueError(
+                    f"prompt of {plen} tokens exceeds max_prompt_len "
+                    f"{self.max_prompt_len}")
+            try:
+                self.cache.admit(slot, plen + req.max_new_tokens)
+            except CacheOutOfPages:
+                break                       # backpressure: wait for pages
+            queue.popleft()
+            toks = np.zeros((1, self.max_prompt_len), np.int32)
+            toks[0, :plen] = np.asarray(req.prompt, np.int32)
+            page_row = jnp.asarray(self.cache.page_table[slot])
+            admit_key = jax.random.fold_in(self._base_key,
+                                           self._n_admits)
+            self._n_admits += 1
+            with phase("prefill"):
+                t0 = time.perf_counter()
+                self.pools, first = self.prefill_fn(
+                    self.pools, jnp.asarray(toks),
+                    jnp.int32(plen), page_row, admit_key)
+                dispatch_s = time.perf_counter() - t0
+            self.cache.lengths[slot] = plen
+            budget_left = req.max_new_tokens - 1
+            c = self.carry
+            self.carry = {
+                "tokens": c["tokens"].at[slot].set(first),
+                "lengths": c["lengths"].at[slot].set(plen),
+                "steps_left": c["steps_left"].at[slot].set(budget_left),
+                "done": c["done"].at[slot].set(budget_left <= 0),
+                "key": c["key"],
+            }
+            self._first_tok[slot] = first
+            self._meta[slot] = {
+                "req": req, "tokens": [], "t_admit": time.perf_counter(),
+                "t_first": None, "finished": None,
+            }
+            self._event("request_admitted", uid=req.uid, slot=slot,
+                        prompt_tokens=plen,
+                        budget=req.max_new_tokens)
+            self._event("span", span="prefill", slot=slot,
+                        tokens=plen, dispatch_s=round(dispatch_s, 6))
+
+    # ------------------------------------------------------------ decode
+    def _decode_window(self) -> None:
+        cfg = self.cache.config
+        page_table = jnp.asarray(self.cache.page_table)
+        active = [s for s, m in self._meta.items()
+                  if m["finished"] is None]
+        # only decode as far as someone can still use: the longest
+        # remaining budget among live slots bounds useful steps
+        # (generated-so-far counts the admit-time first token while it
+        # is still an unharvested future)
+        budget = max(
+            (self._meta[s]["req"].max_new_tokens
+             - len(self._meta[s]["tokens"])
+             - (1 if s in self._first_tok else 0)) for s in active
+        ) if active else 0
+        steps = min(self.harvest_every, max(budget, 0))
+        window: List[jnp.ndarray] = []
+        t0 = time.perf_counter()
+        with phase("decode"):
+            for _ in range(steps):
+                self.pools, self.carry = self.decode_fn(
+                    self.pools, self.carry, page_table)
+                window.append(self.carry["tokens"])
+                self.steps += 1
+        # ---- harvest: ONE batched resolve for the whole window plus
+        # every pending admit-time first token
+        firsts = {s: self._first_tok.pop(s) for s in list(self._first_tok)}
+        stacked = jnp.stack(window) if window else None
+        harvested, firsts_h, done_h = jax.device_get(
+            (stacked, firsts, self.carry["done"]))
+        t_h = time.perf_counter()
+        self.windows += 1
+
+        for slot, tok in firsts_h.items():
+            m = self._meta[slot]
+            m["tokens"].append(int(tok))
+            m["t_first"] = t_h
+            if self.eos_id is not None and int(tok) == self.eos_id:
+                m["finished"] = "eos"
+            elif len(m["tokens"]) >= m["req"].max_new_tokens:
+                m["finished"] = "budget"
+        kept = 0
+        for i in range(steps):
+            for slot, m in self._meta.items():
+                if m["finished"] is not None:
+                    continue
+                tok = int(harvested[i, slot])
+                m["tokens"].append(tok)
+                kept += 1
+                # host length mirror follows the device's write position
+                self.cache.lengths[slot] += 1
+                if self.eos_id is not None and tok == self.eos_id:
+                    m["finished"] = "eos"
+                elif len(m["tokens"]) >= m["req"].max_new_tokens:
+                    m["finished"] = "budget"
+        # tokens = KEPT tokens only: slots that finish (or freeze)
+        # mid-window decode garbage for the rest of it, and counting
+        # that would inflate the serving summary's tokens/s exactly in
+        # the ragged-finish steady state the metric exists to measure
+        self._event(
+            "span", span="decode", steps=steps,
+            slots=len(self._meta), tokens=kept,
+            dur_s=round(t_h - t0, 6),
+        )
+
+        # ---- retire: device `done` and host finish detection agree by
+        # construction (same eos/budget rules); host is authoritative
+        # for truncation, device for freezing
+        for slot in list(self._meta):
+            m = self._meta[slot]
+            if m["finished"] is None and not bool(done_h[slot]):
+                continue
+            reason = m["finished"] or (
+                "eos" if (self.eos_id is not None and m["tokens"]
+                          and m["tokens"][-1] == self.eos_id)
+                else "budget")
+            req = m["req"]
+            comp = Completion(
+                uid=req.uid, tokens=m["tokens"],
+                prompt_len=len(req.prompt), reason=reason,
+                ttft_s=(None if m["t_first"] is None
+                        else m["t_first"] - m["t_admit"]),
+                duration_s=t_h - m["t_admit"],
+            )
+            self.completions[req.uid] = comp
+            self.cache.retire(slot)
+            c = self.carry
+            self.carry = {**c, "done": c["done"].at[slot].set(True)}
+            del self._meta[slot]
+            self._event("request_done", uid=req.uid, slot=slot,
+                        new_tokens=len(comp.tokens), reason=reason,
+                        ttft_s=(None if comp.ttft_s is None
+                                else round(comp.ttft_s, 6)),
+                        duration_s=round(comp.duration_s, 6))
+
+    # --------------------------------------------------------------- run
+    def run(self, requests: Sequence[Request]) -> Dict[Any, Completion]:
+        """Serve ``requests`` to completion; returns ``uid ->``
+        :class:`Completion`.  Re-entrant: call again with more
+        requests — the cache, pools and compiled steps are reused."""
+        queue = collections.deque(requests)
+        while queue or self._meta:
+            self._admit(queue)
+            if not self._meta:
+                if queue:
+                    raise CacheOutOfPages(
+                        "no slot can ever admit the next request "
+                        f"(prompt+budget needs more pages than the "
+                        f"pool holds: {queue[0].uid!r})")
+                break
+            self._decode_window()
+        return self.completions

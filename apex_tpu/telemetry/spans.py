@@ -46,8 +46,11 @@ logger = logging.getLogger("apex_tpu.telemetry")
 #: The step-anatomy phases the example trainers annotate.
 #: ``param_gather`` is the ZeRO-3 gather-on-use weight all-gather
 #: (apex_tpu/parallel/zero3.py) — present only under ``shard_params``.
+#: ``prefill``/``decode`` are the SERVING step anatomy
+#: (apex_tpu/serving/serve.py): prompt ingestion through the training
+#: attention ladder, and the fused per-token cache-attend-sample step.
 PHASES = ("data", "param_gather", "fwd_bwd", "grad_sync", "optimizer",
-          "checkpoint")
+          "checkpoint", "prefill", "decode")
 
 #: Every span shares this prefix so a trace viewer filter of "tlm."
 #: shows exactly the phase segmentation.

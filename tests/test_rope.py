@@ -14,8 +14,79 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from apex_tpu.ops.rope import apply_rope, rope_cos_sin
+from apex_tpu.ops.rope import (
+    apply_rope,
+    apply_rope_at,
+    apply_rope_tables,
+    rope_cos_sin,
+    rope_table,
+)
 from apex_tpu.transformer import parallel_state
+
+
+class TestRopeIncremental:
+    """Position-indexed application for decode (apply_rope_at) and the
+    cached (max_len, dim, dtype)-keyed tables: the incremental path
+    must be BIT-identical to the full-sequence path, or a conversation
+    would drift from its own prefill."""
+
+    def test_table_rows_bit_identical_to_direct(self):
+        cos_t, sin_t = rope_table(64, 16)
+        cos_d, sin_d = rope_cos_sin(jnp.arange(64, dtype=jnp.int32), 16)
+        assert jnp.array_equal(cos_t, cos_d)
+        assert jnp.array_equal(sin_t, sin_d)
+
+    def test_incremental_matches_full_sequence_bitwise(self):
+        x = jax.random.normal(jax.random.PRNGKey(4), (2, 3, 12, 16))
+        full = apply_rope(x, jnp.arange(12))
+        via_tables = apply_rope_at(x, jnp.arange(12), max_len=32)
+        direct = apply_rope_at(x, jnp.arange(12))
+        assert jnp.array_equal(full, via_tables)
+        assert jnp.array_equal(full, direct)
+
+    def test_one_position_at_a_time_matches_batch(self):
+        # the decode loop: rotate position p alone == row p of the
+        # full-sequence rotation, for every p
+        x = jax.random.normal(jax.random.PRNGKey(5), (1, 2, 8, 16))
+        full = apply_rope(x, jnp.arange(8))
+        for p in range(8):
+            one = apply_rope_at(
+                x[:, :, p:p + 1], jnp.array([p]), max_len=16)
+            assert jnp.array_equal(one, full[:, :, p:p + 1]), p
+
+    def test_per_sequence_offsets(self):
+        # (b, s) positions: each sequence rotated at its own offsets
+        x = jax.random.normal(jax.random.PRNGKey(6), (2, 3, 4, 16))
+        pos = jnp.array([[5, 6, 7, 8], [0, 1, 2, 3]], jnp.int32)
+        out = apply_rope_at(x, pos, max_len=16)
+        for b in range(2):
+            want = apply_rope(x[b:b + 1], pos[b])
+            assert jnp.array_equal(out[b:b + 1], want), b
+
+    def test_per_sequence_positions_need_4d(self):
+        with pytest.raises(ValueError, match="b, h, s, d"):
+            apply_rope_at(jnp.zeros((4, 16)),
+                          jnp.zeros((2, 4), jnp.int32))
+
+    def test_table_cache_hit_and_dtype_keying(self):
+        a = rope_table(32, 8)
+        b = rope_table(32, 8)
+        assert a[0] is b[0] and a[1] is b[1]       # cache hit
+        c = rope_table(32, 8, dtype=jnp.bfloat16)
+        assert c[0].dtype == jnp.bfloat16
+        assert c[0] is not a[0]                    # dtype keys the cache
+        d = rope_table(32, 8, base=500.0)
+        assert d[0] is not a[0]                    # base keys the cache
+
+    def test_tables_broadcast_contract(self):
+        # apply_rope_tables with gathered rows == apply_rope_at
+        x = jax.random.normal(jax.random.PRNGKey(7), (2, 2, 4, 8))
+        pos = jnp.array([[3, 4, 5, 6], [0, 2, 4, 6]], jnp.int32)
+        cos, sin = rope_table(16, 8)
+        want = apply_rope_tables(
+            x, cos[pos][:, None], sin[pos][:, None])
+        got = apply_rope_at(x, pos, max_len=16)
+        assert jnp.array_equal(got, want)
 
 
 class TestRopeOp:

@@ -977,6 +977,186 @@ def validate_opt_tail(smoke=False):
     return results
 
 
+def validate_fmha_decode(smoke=False):
+    """Decode-tier sweep (the fourth attention rung): the Pallas paged
+    decode kernel vs the XLA paged reference across serving shapes —
+    batch {1,8,64,256} x cache length {512,2048,8192} x KV dtype
+    {bf16, fp32, int8} — plus the end-to-end gate: GREEDY generation
+    through the full serving stack (paged cache + fmha_decode +
+    continuous batching) must produce token-identical output to the
+    naive full-recompute reference at kv_dtype=None.
+
+    Two gates ride these rows in main(): parity (gate 1, relative to
+    the XLA path's own error vs the fp32 ground truth — both paths pay
+    the same output-dtype quantization) and no-loss (gate 2: the
+    kernel must not lose to the XLA reference at ANY swept cell —
+    decode is explicit-dispatch, so a losing cell is a kernel bug, not
+    a crossover to move).  ``decode_gbs`` is the number that matters at
+    decode's ~2 FLOPs/byte: achieved KV-stream bandwidth."""
+    from apex_tpu.ops.attention_decode import (
+        fmha_decode,
+        paged_attention_reference,
+    )
+    from apex_tpu.ops.quantization import quantize_rows
+
+    results = []
+    h, d, ps = 4, 128, 64
+    kv_block = 128
+    batches = [1, 8, 64, 256]
+    caches = [512, 2048, 8192]
+    kvs = ["bfloat16", "float32", "int8"]
+    if smoke:
+        batches, caches, kvs = [8], [512], ["bfloat16", "int8"]
+    for b in batches:
+        for cache in caches:
+            npp = cache // ps
+            pool_pages = 1 + b * npp        # page 0 = reserved null
+            key = jax.random.PRNGKey(0)
+            k0, k1, k2, k3 = jax.random.split(key, 4)
+            km = jax.random.normal(k0, (pool_pages, h, ps, d),
+                                   jnp.bfloat16)
+            vm = jax.random.normal(k1, (pool_pages, h, ps, d),
+                                   jnp.bfloat16)
+            q = jax.random.normal(k2, (b, h, 1, d), jnp.bfloat16)
+            # REAL paging: a shuffled physical layout, and ragged
+            # lengths so odd sequences end on a partially-filled page
+            perm = jax.random.permutation(
+                k3, jnp.arange(1, pool_pages, dtype=jnp.int32))
+            page_table = perm[: b * npp].reshape(b, npp)
+            lengths = jnp.where(
+                jnp.arange(b) % 2 == 0, cache, cache - ps // 2 - 1
+            ).astype(jnp.int32)
+            for kv in kvs:
+                if kv == "int8":
+                    def q8(pages):
+                        vals, scales = quantize_rows(
+                            pages.reshape(-1, d).astype(jnp.float32),
+                            kv_block)
+                        return (vals.reshape(pages.shape),
+                                scales.reshape(*pages.shape[:-1], -1))
+
+                    kp, ks = q8(km)
+                    vp, vs = q8(vm)
+                else:
+                    dt = jnp.dtype(kv)
+                    kp, vp = km.astype(dt), vm.astype(dt)
+                    ks = vs = None
+                kwargs = dict(k_scales=ks, v_scales=vs,
+                              kv_block=kv_block)
+
+                def fwd_t(impl):
+                    return jax.jit(
+                        lambda q, kp, vp: jnp.sum(fmha_decode(
+                            q, kp, vp, page_table, lengths,
+                            implementation=impl, **kwargs,
+                        ).astype(jnp.float32)))
+
+                # fp32 ground truth on a subset of sequences, over a
+                # sub-pool of ONLY the pages that subset references
+                # (converting the whole b=256 x 8k pool to fp32 would
+                # transiently eat ~8 GB — parity does not need every
+                # page, timing does).  Sub-pool index 0 keeps the null-
+                # page convention; the remapped table is dense 1..n.
+                bp = min(b, 32)
+                used = jnp.concatenate([
+                    jnp.zeros((1,), jnp.int32),
+                    page_table[:bp].reshape(-1),
+                ])
+                sub_table = (1 + jnp.arange(
+                    bp * npp, dtype=jnp.int32)).reshape(bp, npp)
+                with jax.default_matmul_precision("highest"):
+                    kp_s = jnp.take(kp, used, axis=0)
+                    vp_s = jnp.take(vp, used, axis=0)
+                    if kv == "int8":
+                        from apex_tpu.ops.attention_decode import (
+                            _dequant_pages,
+                        )
+                        kr = _dequant_pages(
+                            kp_s, jnp.take(ks, used, axis=0), kv_block)
+                        vr = _dequant_pages(
+                            vp_s, jnp.take(vs, used, axis=0), kv_block)
+                    else:
+                        kr, vr = (kp_s.astype(jnp.float32),
+                                  vp_s.astype(jnp.float32))
+                    ref = jax.jit(
+                        lambda q, kr, vr: paged_attention_reference(
+                            q, kr, vr, sub_table, lengths[:bp]))(
+                        q[:bp].astype(jnp.float32), kr, vr)
+                out_p = jax.device_get(jax.jit(
+                    lambda q, kp, vp: fmha_decode(
+                        q, kp, vp, page_table[:bp], lengths[:bp],
+                        implementation="pallas", **kwargs,
+                    ))(q[:bp], kp, vp))
+                out_x = jax.device_get(jax.jit(
+                    lambda q, kp, vp: fmha_decode(
+                        q, kp, vp, page_table[:bp], lengths[:bp],
+                        implementation="xla", **kwargs,
+                    ))(q[:bp], kp, vp))
+                iters = 10 if smoke else 50
+                p_ms = _time(fwd_t("pallas"), q, kp, vp, iters=iters)
+                x_ms = _time(fwd_t("xla"), q, kp, vp, iters=iters)
+                kv_bytes = 2 * b * npp * ps * h * d * \
+                    jnp.dtype(kp.dtype).itemsize
+                results.append({
+                    "kernel": "fmha_decode",
+                    "shape": [b, h, 1, d],
+                    "cache_len": cache,
+                    "page_size": ps,
+                    "dtype": kv,
+                    "causal": True,
+                    "auto_impl": "pallas",
+                    "fwd": {
+                        "pallas_ms": round(p_ms, 3),
+                        "xla_ms": round(x_ms, 3),
+                        "speedup": round(x_ms / p_ms, 2),
+                        "decode_gbs": round(
+                            kv_bytes / (p_ms * 1e-3) / 1e9, 1),
+                        "max_err_vs_fp32": _max_err(out_p, ref),
+                        "xla_err_vs_fp32": _max_err(out_x, ref),
+                    },
+                })
+                print(json.dumps(results[-1]))
+
+    # ---- end-to-end greedy-generation gate: the paged serving stack
+    # must reproduce the unpaged full-recompute reference exactly
+    import numpy as np
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    if parallel_state.model_parallel_is_initialized():
+        parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        devices=jax.devices()[:1])
+    model = GPTModel(GPTConfig(
+        vocab_size=512, num_layers=2, hidden_size=512,
+        num_attention_heads=4, max_position_embeddings=64,
+        compute_dtype=jnp.bfloat16, remat=False,
+    ))
+    params = model.init(jax.random.PRNGKey(0))
+    bgen, sp, new = 4, 16, 32
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(1, 512, (bgen, sp)).astype(np.int32)
+    plens = np.array([sp, sp - 3, sp - 7, 5], np.int32)
+    for i in range(bgen):
+        prompts[i, plens[i]:] = 0
+    ref_toks = model.generate_reference(params, prompts, plens, new,
+                                        mesh=mesh)
+    got = model.generate(params, prompts, plens, new, mesh=mesh,
+                         page_size=16, max_seqs=2, harvest_every=4)
+    match = all(list(ref_toks[i]) == got[i] for i in range(bgen))
+    results.append({
+        "kernel": "decode_generation",
+        "shape": [bgen, sp, new],
+        "dtype": "bfloat16",
+        "greedy_match": bool(match),
+        "note": "paged serving stack (continuous batching, 2 slots / "
+                "4 requests) vs naive full-recompute greedy reference",
+    })
+    print(json.dumps(results[-1]))
+    return results
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
@@ -995,6 +1175,7 @@ def main():
     entries += validate_softmax(smoke=args.smoke)
     entries += validate_fused_dense(smoke=args.smoke)
     entries += validate_opt_tail(smoke=args.smoke)
+    entries += validate_fmha_decode(smoke=args.smoke)
     from apex_tpu.ops.attention_mid import mid_seq_threshold
     from apex_tpu.ops.attention_short import short_seq_threshold
     doc = {
@@ -1102,6 +1283,16 @@ def main():
                 e["fwd"].get("speedup_vs_flash", 0.0) < 2.0:
             bad.append((e, "selected impl under 2x flash fwd at the "
                            "flagship shape (s=1024 causal bf16)"))
+    # (6) decode: the serving stack's greedy generation must be token-
+    #     identical to the full-recompute reference (the paged cache +
+    #     fused decode changed no semantics).  The per-cell no-loss
+    #     gate for fmha_decode rows is gate (2) — decode is explicit
+    #     dispatch, so a losing cell is a kernel bug, not a crossover.
+    for e in entries:
+        if e.get("kernel") == "decode_generation" and \
+                not e.get("greedy_match", True):
+            bad.append((e, "paged greedy generation diverged from the "
+                           "full-recompute reference"))
     if True in flag and False in flag:
         # same shipped config on both sides (best-of-sweep could pick
         # different blocks per causality and fake a skip win)

@@ -16,7 +16,12 @@ docs/observability.md) and reports what a final tokens/s number cannot:
 - **event timeline** — every subsystem event (checkpoint saves /
   verify outcomes / guard escalations / GC / watchdog stalls /
   comm-bucket estimates) with run-relative timestamps and per-kind
-  counts, interleaved with the step indices they landed between.
+  counts, interleaved with the step indices they landed between;
+- **serving summary** — when the stream came from a serving run
+  (``apex_tpu/serving/serve.py``'s ``tlm.prefill``/``tlm.decode``
+  ``span`` records + ``request_done`` events): per-window decode
+  tokens/s, time-to-first-token stats, inter-token latency
+  percentiles, and request completion counts by reason.
 
 Usage::
 
@@ -65,6 +70,82 @@ def _stats(xs: List[float], better=max) -> Dict[str, float]:
         "best": better(xs),  # max for rates, min for ms/step
         "final": xs[-1],
     }
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    """Nearest-rank percentile (no numpy dependency here)."""
+    s = sorted(xs)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+def summarize_serving(records: List[dict]) -> Optional[Dict[str, Any]]:
+    """The serving section: decode throughput per harvest window, TTFT,
+    and inter-token latency from the ``span``/``request_done`` event
+    stream ``ContinuousBatcher`` emits.  None when the stream holds no
+    serving records (training runs keep their report unchanged)."""
+    spans = [r for r in records
+             if r.get("kind") == "event" and r.get("event") == "span"]
+    done = [r for r in records
+            if r.get("kind") == "event"
+            and r.get("event") == "request_done"]
+    decode = [r for r in spans if r.get("span") == "decode"
+              and r.get("steps")]
+    prefill = [r for r in spans if r.get("span") == "prefill"]
+    if not (decode or prefill or done):
+        return None
+    out: Dict[str, Any] = {}
+    if decode:
+        windows = []
+        itl: List[float] = []       # per-window mean inter-token s
+        for r in decode:
+            dur = float(r.get("dur_s", 0.0))
+            steps = int(r.get("steps", 0))
+            toks = int(r.get("tokens", 0))
+            w = {"steps": steps, "tokens": toks,
+                 "dur_s": round(dur, 6)}
+            if dur > 0 and toks:
+                w["tokens_per_sec"] = round(toks / dur, 1)
+            if dur > 0 and steps:
+                itl.append(dur / steps)
+            windows.append(w)
+        out["decode_windows"] = windows
+        rates = [w["tokens_per_sec"] for w in windows
+                 if "tokens_per_sec" in w]
+        if rates:
+            out["decode_tokens_per_sec"] = _stats(rates)
+        if itl:
+            # the harvest window quantizes this to window-mean
+            # granularity (serve.py docstring) — percentiles are over
+            # per-window means, honest about what was measured
+            out["inter_token_latency_ms"] = {
+                "p50": round(_percentile(itl, 50) * 1e3, 3),
+                "p90": round(_percentile(itl, 90) * 1e3, 3),
+                "p99": round(_percentile(itl, 99) * 1e3, 3),
+                "mean": round(sum(itl) / len(itl) * 1e3, 3),
+            }
+    if prefill:
+        out["prefill_spans"] = len(prefill)
+        ptoks = [int(r["tokens"]) for r in prefill if "tokens" in r]
+        if ptoks:
+            out["prefill_tokens"] = sum(ptoks)
+    if done:
+        reasons: Dict[str, int] = {}
+        ttfts = []
+        for r in done:
+            reasons[str(r.get("reason", "?"))] = \
+                reasons.get(str(r.get("reason", "?")), 0) + 1
+            if isinstance(r.get("ttft_s"), (int, float)):
+                ttfts.append(float(r["ttft_s"]))
+        out["requests"] = {"completed": len(done), "by_reason": reasons}
+        if ttfts:
+            out["ttft_s"] = {
+                "p50": round(_percentile(ttfts, 50), 6),
+                "p95": round(_percentile(ttfts, 95), 6),
+                "mean": round(sum(ttfts) / len(ttfts), 6),
+                "max": round(max(ttfts), 6),
+            }
+    return out
 
 
 def summarize(records: List[dict]) -> Dict[str, Any]:
@@ -159,11 +240,19 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
                       # GB/s when measured standalone
                       "fused", "buffers", "buffer_bytes",
                       "moment_dtype", "unscale_folded", "self_ms",
-                      "gbs"):
+                      "gbs",
+                      # serving span / request fields
+                      "span", "steps", "slots", "tokens", "dur_s",
+                      "uid", "slot", "reason", "new_tokens",
+                      "ttft_s"):
                 if k in r:
                     entry[k] = r[k]
             timeline.append(entry)
         out["events"] = {"counts": counts, "timeline": timeline}
+
+    serving = summarize_serving(records)
+    if serving:
+        out["serving"] = serving
 
     return out
 
@@ -229,6 +318,35 @@ def format_report(summary: Dict[str, Any]) -> str:
         if "counters" in met:
             lines.append("counters: " + "  ".join(
                 f"{k}={v}" for k, v in met["counters"].items()))
+    sv = summary.get("serving")
+    if sv:
+        lines.append("serving summary:")
+        if "decode_tokens_per_sec" in sv:
+            s = sv["decode_tokens_per_sec"]
+            lines.append(
+                f"  decode tokens/s per window: mean {s['mean']:.4g}  "
+                f"best {s['best']:.4g}  final {s['final']:.4g}")
+        if "inter_token_latency_ms" in sv:
+            i = sv["inter_token_latency_ms"]
+            lines.append(
+                f"  inter-token latency (window means): "
+                f"p50 {i['p50']} ms  p90 {i['p90']} ms  "
+                f"p99 {i['p99']} ms")
+        if "ttft_s" in sv:
+            t = sv["ttft_s"]
+            lines.append(
+                f"  time-to-first-token: p50 {t['p50']}s  "
+                f"p95 {t['p95']}s  max {t['max']}s "
+                f"(quantized to the harvest cadence)")
+        if "requests" in sv:
+            r = sv["requests"]
+            by = "  ".join(f"{k}={v}"
+                           for k, v in sorted(r["by_reason"].items()))
+            lines.append(f"  requests completed: {r['completed']} ({by})")
+        if "prefill_spans" in sv:
+            lines.append(
+                f"  prefill: {sv['prefill_spans']} admissions, "
+                f"{sv.get('prefill_tokens', '?')} prompt tokens")
     ev = summary.get("events")
     if ev:
         lines.append("events: " + "  ".join(
