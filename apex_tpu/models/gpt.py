@@ -61,12 +61,14 @@ __all__ = ["GPTConfig", "GPTModel", "GPTDecodeFns"]
 
 @dataclasses.dataclass
 class GPTDecodeFns:
-    """The compiled serving step pair :meth:`GPTModel.decode_fns`
+    """The compiled serving step functions :meth:`GPTModel.decode_fns`
     returns.  ``prefill``/``decode`` are params-bound callables matching
     :class:`apex_tpu.serving.serve.ContinuousBatcher`'s contract;
     ``prefill_jit``/``decode_jit`` are the underlying ``jax.jit``
     objects (their ``_cache_size()`` is what the no-recompile tests
-    assert on)."""
+    assert on).  ``chunk``/``chunk_jit`` are the chunked-prefill step
+    (present only when ``decode_fns(prefill_chunk=C)`` asked for it)
+    and ``prefill_chunk`` its chunk size."""
 
     prefill: Any
     decode: Any
@@ -77,6 +79,9 @@ class GPTDecodeFns:
     #: sees the callables) can reject a mismatched truncation id — the
     #: device's freeze rule and the host's truncation rule must agree.
     eos_id: Any = None
+    chunk: Any = None
+    chunk_jit: Any = None
+    prefill_chunk: Any = None
 
 
 @dataclasses.dataclass
@@ -690,6 +695,126 @@ class GPTModel:
         x = self._norm(params["final_ln"], x.astype(jnp.float32))
         return x.astype(c.compute_dtype), ks, vs
 
+    def prefill_chunk(
+        self,
+        params: Dict[str, Any],
+        tokens: jnp.ndarray,
+        start: jnp.ndarray,
+        prompt_len: jnp.ndarray,
+        write_from: jnp.ndarray,
+        page_row: jnp.ndarray,
+        pools: Dict[str, jnp.ndarray],
+        *,
+        quantized: bool = False,
+        kv_block: int = 128,
+    ):
+        """ONE fixed-size prompt-ingestion chunk for a single serving
+        slot — the Sarathi-style alternative to :meth:`prefill_forward`
+        that lets the scheduler interleave prompt work with decode
+        steps.  ``tokens (1, C)`` are prompt ids at global positions
+        ``start .. start + C`` (rows at or past ``prompt_len`` are
+        padding); each layer writes the chunk's K/V into the slot's
+        pages (positions below ``write_from`` — a prefix-cache hit's
+        already-shared region — are masked to the null page, never
+        recomputed onto shared pages) and attends over the cache
+        INCLUDING its own just-written pages through
+        :func:`~apex_tpu.ops.attention_decode.fmha_decode`'s small-s_q
+        path, per-row causal at position ``start + i``.  Shapes are
+        fixed by ``C``/``pages_per_seq`` alone — any chunk count, start
+        offset or hit pattern reuses ONE compilation.
+
+        Returns ``(logits (vocab/tp,), new_pools)`` — the logits of the
+        LAST VALID prompt row (position ``prompt_len - 1``, clipped into
+        this chunk); the caller samples the first generated token from
+        the chunk that contains it and ignores the rest.
+
+        Numerics: chunk boundaries are absolute (chunk ``k`` always
+        covers ``[k*C, (k+1)*C)``) and attention reads K/V from the
+        POOLS, so a hit admission that skips fully-matched chunks
+        produces BIT-identical logits to a cold admission of the same
+        prompt — the skipped region's pages hold the same bits either
+        way (``_dryrun_chunked_prefill`` gates this)."""
+        from apex_tpu.ops.attention_decode import fmha_decode
+        from apex_tpu.serving.kv_cache import write_targets, write_tokens
+
+        c = self.config
+        if self.moe is not None:
+            raise NotImplementedError("MoE decode is not supported")
+        C = tokens.shape[-1]
+        tokens = tokens.reshape(1, C)
+        page_size = pools["k"].shape[3]
+        start = jnp.asarray(start, jnp.int32)
+        prompt_len = jnp.asarray(prompt_len, jnp.int32)
+        write_from = jnp.asarray(write_from, jnp.int32)
+        positions = start + jnp.arange(C, dtype=jnp.int32)
+        valid = positions < prompt_len
+        writev = valid & (positions >= write_from)
+
+        x = self.embedding.apply(params["embedding"], tokens)
+        if c.position_embedding == "learned":
+            pos = jnp.clip(positions, 0, c.max_position_embeddings - 1)
+            x = x + jnp.take(
+                params["pos_embedding"], pos, axis=0
+            )[None].astype(x.dtype)
+        x = x.astype(c.compute_dtype)
+
+        rope_cs = None
+        if c.position_embedding == "rope":
+            from apex_tpu.ops.rope import rope_table
+
+            # same cached-table gather as decode_step: chunk rows come
+            # from the bit-identical full table, so prefill and decode
+            # rotations cannot drift
+            max_len = page_row.shape[0] * page_size
+            cos_t, sin_t = rope_table(max_len, c.head_dim,
+                                      base=c.rope_base)
+            pos = jnp.clip(positions, 0, max_len - 1)
+            rope_cs = (jnp.take(cos_t, pos, axis=0)[None],
+                       jnp.take(sin_t, pos, axis=0)[None])  # (1, C, d/2)
+
+        # the chunk attends over start + C cache positions: padding
+        # rows past prompt_len see (and produce) garbage, but a valid
+        # row's causal mask stops at its own position, which its own
+        # just-written page covers — write-before-attend per layer
+        attend = jnp.reshape(start + C, (1,)).astype(jnp.int32)
+        wp, wo = write_targets(page_row, positions, writev, page_size)
+        decode_impl = "xla" if c.attention_impl == "xla" else None
+
+        def body(x, scanned):
+            lp, pool_l = scanned
+            residual = x
+            y = self._norm(lp["ln1"], x).astype(c.compute_dtype)
+            q, k, v = self._qkv_heads(lp, y)      # (1, hl, C, d)
+            if rope_cs is not None:
+                from apex_tpu.ops.rope import apply_rope_tables
+
+                k = apply_rope_tables(
+                    k, rope_cs[0][:, None], rope_cs[1][:, None])
+            pool_l = write_tokens(
+                pool_l, jnp.moveaxis(k[0], 1, 0),
+                jnp.moveaxis(v[0], 1, 0), wp, wo,
+                quantized=quantized, kv_block=kv_block)
+            attn = fmha_decode(
+                q, pool_l["k"], pool_l["v"], page_row[None], attend,
+                causal=True, k_scales=pool_l.get("k_scales"),
+                v_scales=pool_l.get("v_scales"), kv_block=kv_block,
+                rope=rope_cs, implementation=decode_impl)
+            attn = jnp.moveaxis(attn, 1, 2).reshape(1, C, -1)
+            out = self.attn_proj.apply(lp["attn_proj"], attn)
+            x = residual + out.astype(residual.dtype)
+            residual = x
+            y = self._norm(lp["ln2"], x).astype(c.compute_dtype)
+            y = self._dense_mlp(lp, y)
+            return residual + y.astype(residual.dtype), pool_l
+
+        x, new_pools = jax.lax.scan(body, x, (params["layers"], pools))
+        x = self._norm(params["final_ln"], x.astype(jnp.float32))
+        last_row = jnp.clip(prompt_len - 1 - start, 0, C - 1)
+        last = jnp.take(x[0], last_row, axis=0)          # (h,)
+        logits = self.logits(
+            params, last[None, None].astype(c.compute_dtype))[0, 0]
+        return logits, new_pools
+
     def decode_step(
         self,
         params: Dict[str, Any],
@@ -795,18 +920,29 @@ class GPTModel:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         eos_id: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
     ):
-        """Build the jitted ``(prefill, decode)`` step pair the
+        """Build the jitted serving step functions the
         continuous-batching driver
-        (:class:`apex_tpu.serving.serve.ContinuousBatcher`) runs.
+        (:class:`apex_tpu.serving.serve.ContinuousBatcher`) runs:
+        ``(prefill, decode)``, plus a chunked-prefill step when
+        ``prefill_chunk`` (a chunk size in tokens) is given — the
+        :meth:`prefill_chunk` path the stall-free scheduler drives.
 
-        Both close over nothing dynamic: params ride as an argument
+        All close over nothing dynamic: params ride as an argument
         through ONE jit each, every other shape comes from
-        ``cache_config``/``max_prompt_len``, so the pair compiles once
-        for the server's lifetime.  Returns a :class:`GPTDecodeFns`
-        carrying the bound callables plus the raw jitted functions
-        (``prefill_jit``/``decode_jit``) — the seam the
-        compile-counting tests spy on.
+        ``cache_config``/``max_prompt_len``/``prefill_chunk``, so each
+        compiles once for the server's lifetime.  Returns a
+        :class:`GPTDecodeFns` carrying the bound callables plus the raw
+        jitted functions (``prefill_jit``/``decode_jit``/``chunk_jit``)
+        — the seam the compile-counting tests spy on.
+
+        Sampling keys are PER SLOT: the decode carry holds a
+        ``sample_keys`` row per slot (set at admission — from
+        ``Request.seed`` when given) and every draw folds in the
+        slot's current context length, so a seeded request's sampled
+        stream is reproducible regardless of admission order or slot
+        assignment (tests/test_serving.py pins it).
 
         Serving runs dp-replicated on the mesh; tensor/pipeline/
         context-parallel decode is not implemented (the cache pools
@@ -862,9 +998,21 @@ class GPTModel:
             pools = jax.vmap(write_layer)(pools, ks, vs)
             last = jnp.take(hidden[0], length - 1, axis=0)  # (h,)
             logits = self.logits(params, last[None, None])[0, 0]
-            tok = sample(logits[None], key, temperature, top_k,
-                         top_p)[0]
+            # the draw after L context tokens folds L into the slot key
+            # — the ONE key schedule shared with _chunk and _decode, so
+            # chunked and monolithic prefill sample identically
+            tok = sample(logits[None], jax.random.fold_in(key, length),
+                         temperature, top_k, top_p)[0]
             return pools, tok
+
+        def _chunk(params, pools, toks, start, plen, write_from,
+                   page_row, key):
+            logits, pools = self.prefill_chunk(
+                params, toks, start, plen, write_from, page_row,
+                pools, quantized=cfg.quantized, kv_block=cfg.kv_block)
+            tok = sample(logits[None], jax.random.fold_in(key, plen),
+                         temperature, top_k, top_p)[0]
+            return pools, tok, logits
 
         def _decode(params, pools, carry, page_table):
             active = jnp.logical_not(carry["done"])
@@ -872,8 +1020,19 @@ class GPTModel:
                 params, carry["tokens"], carry["lengths"], active,
                 page_table, pools, quantized=cfg.quantized,
                 kv_block=cfg.kv_block)
-            key, sub = jax.random.split(carry["key"])
-            sampled = sample(logits, sub, temperature, top_k, top_p)
+            if temperature == 0.0:
+                sampled = sample(logits, None, 0.0)
+            else:
+                # per-slot draw: fold the slot's context length into
+                # ITS key, so a seeded request samples the same stream
+                # in any slot at any admission order
+                ctx = jnp.where(active, carry["lengths"] + 1, 0)
+                subs = jax.vmap(jax.random.fold_in)(
+                    carry["sample_keys"], ctx)
+                sampled = jax.vmap(
+                    lambda l, k: sample(l[None], k, temperature,
+                                        top_k, top_p)[0]
+                )(logits, subs)
             ai = active.astype(jnp.int32)
             tokens = jnp.where(active, sampled, carry["tokens"])
             steps_left = carry["steps_left"] - ai
@@ -886,7 +1045,7 @@ class GPTModel:
                 "lengths": carry["lengths"] + ai,
                 "steps_left": steps_left,
                 "done": done,
-                "key": key,
+                "sample_keys": carry["sample_keys"],
             }
 
         from apex_tpu.serving.serve import init_carry
@@ -908,12 +1067,55 @@ class GPTModel:
         # the batcher only sees the callables; stamp the freeze id so
         # it can reject a host truncation id the device disagrees with
         decode.eos_id = eos_id
+        chunk = cj = None
+        if prefill_chunk is not None:
+            from apex_tpu.ops.attention_decode import (
+                FMHA_DECODE_MAX_ROWS,
+            )
+
+            if int(prefill_chunk) < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}")
+            if int(prefill_chunk) > FMHA_DECODE_MAX_ROWS:
+                # past the row budget even block_h=1 cannot keep the
+                # kernel's fp32 scratch inside the VMEM bound — fail at
+                # build time, not with an opaque lowering error at
+                # serve time
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} exceeds the decode "
+                    f"kernel's per-program row budget "
+                    f"(FMHA_DECODE_MAX_ROWS={FMHA_DECODE_MAX_ROWS}); "
+                    "use a smaller chunk — serving stalls shrink with "
+                    "it anyway (docs/serving.md)")
+            cj = jax.jit(shard_map(
+                _chunk, mesh=mesh,
+                in_specs=(specs, pool_specs, P(), P(), P(), P(), P(),
+                          P()),
+                out_specs=(pool_specs, P(), P()),
+            ))
+            C = int(prefill_chunk)
+
+            def chunk(pools, toks, start, plen, write_from, row, key,
+                      _cj=cj, _C=C):
+                toks = jnp.asarray(toks, jnp.int32).reshape(1, _C)
+                return _cj(params, pools, toks,
+                           jnp.int32(start), jnp.int32(plen),
+                           jnp.int32(write_from), row, key)
+
+            # stamped like decode.eos_id: the batcher schedules chunks
+            # of ITS size and must reject a step compiled for another
+            chunk.prefill_chunk = C
+
         return GPTDecodeFns(
             prefill=prefill,
             decode=decode,
             prefill_jit=pf,
             decode_jit=df,
             eos_id=eos_id,
+            chunk=chunk,
+            chunk_jit=cj,
+            prefill_chunk=(None if prefill_chunk is None
+                           else int(prefill_chunk)),
         )
 
     def generate(
@@ -936,14 +1138,20 @@ class GPTModel:
         max_seqs: Optional[int] = None,
         num_pages: Optional[int] = None,
         logger: Optional[Any] = None,
+        prefill_chunk: Optional[int] = None,
+        prefix_cache: bool = False,
     ):
         """Generate from ``prompts (b, s)`` (right-padded; real lengths
         in ``prompt_lengths``) through the full serving stack — paged
         KV cache, fused decode kernel, on-device sampling, continuous
         batching.  ``max_seqs`` (default ``b``) bounds concurrent
         slots, so ``b > max_seqs`` exercises real admit/retire churn.
-        ``kv_dtype=jnp.int8`` stores the cache quantized.  Returns the
-        per-prompt generated token lists (EOS included when hit)."""
+        ``kv_dtype=jnp.int8`` stores the cache quantized.
+        ``prefill_chunk`` switches prompt ingestion to the stall-free
+        chunked scheduler (docs/serving.md) and ``prefix_cache``
+        additionally shares identical prompt prefixes across requests.
+        Returns the per-prompt generated token lists (EOS included
+        when hit)."""
         import numpy as np
 
         from apex_tpu.serving.kv_cache import (
@@ -974,12 +1182,13 @@ class GPTModel:
         fns = self.decode_fns(
             params, mesh, ccfg, max_prompt_len=s,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            eos_id=eos_id)
+            eos_id=eos_id, prefill_chunk=prefill_chunk)
         batcher = ContinuousBatcher(
             fns.prefill, fns.decode, PagedKVCache(ccfg),
             init_pools(ccfg), max_prompt_len=s,
             harvest_every=harvest_every, eos_id=eos_id, key=key,
-            logger=logger)
+            logger=logger, chunk_fn=fns.chunk,
+            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache)
         reqs = [
             Request(uid=i,
                     prompt=[int(t) for t in

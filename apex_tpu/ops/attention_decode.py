@@ -82,6 +82,7 @@ __all__ = [
     "paged_attention_reference",
     "decode_contiguous",
     "FMHA_DECODE_BLOCK_H",
+    "FMHA_DECODE_MAX_ROWS",
 ]
 
 _LANES = 128
@@ -91,6 +92,13 @@ _LANES = 128
 #: resident and unrolls their per-page dots back-to-back over one page
 #: DMA.  16 matches FMHA_SHORT_MAX_BLOCK_BH's measured code-size bound.
 FMHA_DECODE_BLOCK_H = 16
+
+#: VMEM-residency bound on the per-program query rows (block_h * sq):
+#: the acc/m/l scratch buffers are (block_h*sq, d|128) fp32, so at the
+#: chunked-prefill sq's (64/256) the s_q=1 head packing must shrink —
+#: 512 rows keeps the three buffers under ~1 MB at d=128 while leaving
+#: the s_q=1 default (block_h=16) untouched.
+FMHA_DECODE_MAX_ROWS = 512
 
 
 class _DecodeConfig(NamedTuple):
@@ -354,8 +362,14 @@ def _rope_operands(q, rope: Tuple[jnp.ndarray, jnp.ndarray]):
     return _rotate_half(q.astype(jnp.float32)), full(cos), full(sin)
 
 
-def _pick_block_h(h: int) -> int:
-    bh = min(h, FMHA_DECODE_BLOCK_H)
+def _pick_block_h(h: int, sq: int = 1) -> int:
+    """Largest head packing that divides ``h``, capped by the code-size
+    bound AND the VMEM row budget (``block_h * sq <=
+    FMHA_DECODE_MAX_ROWS``): a chunked-prefill ``sq`` of 256 packs
+    fewer heads per program than the s_q=1 decode default so the
+    fp32 accumulator scratch stays resident."""
+    bh = max(1, min(h, FMHA_DECODE_BLOCK_H,
+                    FMHA_DECODE_MAX_ROWS // max(sq, 1)))
     while h % bh:
         bh -= 1
     return bh
@@ -463,9 +477,20 @@ def fmha_decode(
         )
 
     def _pallas_path():
-        bh = _pick_block_h(h) if block_h is None else int(block_h)
+        bh = _pick_block_h(h, sq) if block_h is None else int(block_h)
         if h % bh:
             raise ValueError(f"block_h {bh} must divide heads {h}")
+        if bh * sq > FMHA_DECODE_MAX_ROWS:
+            # the per-program fp32 scratch is (block_h*sq) rows — past
+            # the budget even block_h=1 cannot honor it, and lowering
+            # failures at serve time are opaque.  Decode s_q is "1 or
+            # a small chunk" by design; bigger tiles belong to the
+            # training ladder (or implementation="xla").
+            raise ValueError(
+                f"block_h*sq = {bh}*{sq} exceeds the decode kernel's "
+                f"per-program row budget (FMHA_DECODE_MAX_ROWS="
+                f"{FMHA_DECODE_MAX_ROWS}); chunk the query (sq <= "
+                f"{FMHA_DECODE_MAX_ROWS}) or use implementation='xla'")
         cfg = _DecodeConfig(
             sm_scale=scale, causal=causal, sq=sq, block_h=bh,
             page_size=k_pages.shape[2], num_pages=page_table.shape[1],

@@ -15,8 +15,11 @@ it at hardware speed.  Three modules, one layer each:
   async-harvest discipline applied to decode).
 - :mod:`~apex_tpu.serving.serve` — the continuous-batching driver:
   admit/retire requests per step into fixed-shape slots so the decode
-  step compiles once; prefill runs the training attention ladder,
-  decode runs :func:`~apex_tpu.ops.attention_decode.fmha_decode`.
+  step compiles once; prefill runs the training attention ladder
+  monolithically or, stall-free, as fixed-size chunks through
+  ``fmha_decode``'s small-s_q path (one chunk per serving step,
+  Sarathi-style), with ref-counted prefix caching sharing identical
+  prompt prefixes across requests.
 
 The model side (``GPTModel.decode_fns`` / ``GPTModel.generate``) builds
 the step functions this package drives.  docs/serving.md is the guide.
@@ -30,8 +33,10 @@ _LAZY_ATTRS = {
     "PageAllocator": "apex_tpu.serving.kv_cache",
     "PagedKVCache": "apex_tpu.serving.kv_cache",
     "CacheOutOfPages": "apex_tpu.serving.kv_cache",
+    "AdmitResult": "apex_tpu.serving.kv_cache",
     "init_pools": "apex_tpu.serving.kv_cache",
     "write_tokens": "apex_tpu.serving.kv_cache",
+    "copy_pages": "apex_tpu.serving.kv_cache",
     "greedy": "apex_tpu.serving.sampling",
     "sample": "apex_tpu.serving.sampling",
     "Request": "apex_tpu.serving.serve",

@@ -34,6 +34,14 @@ wire).  The decode kernel dequantizes pages in VMEM; at decode's ~2
 FLOPs/byte arithmetic intensity the halved (vs bf16) HBM stream is the
 throughput win, and the tolerance band is gated in
 ``tests/test_attention_decode.py`` and the ``_dryrun_decode`` config.
+
+**Prefix caching** rides the same allocator: pages are refcounted,
+:class:`PagedKVCache` keeps a cumulative-hash index of full prompt
+pages, and admissions share matched pages read-only instead of
+recomputing them (:class:`AdmitResult`; :func:`copy_pages` is the
+copy-on-write for a match ending mid-page).  docs/serving.md spells
+out the contract — what is hashed, when pages are copied, and that
+eviction is pure refcount GC.
 """
 
 from __future__ import annotations
@@ -47,11 +55,13 @@ import numpy as np
 __all__ = [
     "KVCacheConfig",
     "CacheOutOfPages",
+    "AdmitResult",
     "PageAllocator",
     "PagedKVCache",
     "init_pools",
     "write_tokens",
     "write_targets",
+    "copy_pages",
 ]
 
 
@@ -118,45 +128,95 @@ class KVCacheConfig:
 
 
 class PageAllocator:
-    """Free-list page allocator.  Page 0 is never handed out.
+    """Refcounted free-list page allocator.  Page 0 is never handed out.
 
-    Invariants (tests/test_serving.py): a page is owned by at most one
-    caller at a time; ``free`` rejects pages not currently allocated
-    (double-free) and page 0; freed pages are reusable immediately —
-    the free list is LIFO, so a hot slot's pages stay cache-warm."""
+    Invariants (tests/test_serving.py): ``free`` rejects pages not
+    currently allocated (double-free) and page 0; freed pages are
+    reusable immediately — the free list is LIFO, so a hot slot's pages
+    stay cache-warm.  Prefix caching shares pages READ-ONLY across
+    holders: ``share`` adds a reference, ``free`` drops one, and a page
+    returns to the free list only at refcount zero — so a slot retiring
+    while another slot (or the prefix index) still reads its pages can
+    never recycle them out from under the reader."""
 
     def __init__(self, num_pages: int):
         if num_pages < 2:
             raise ValueError("need >= 2 pages (page 0 is reserved)")
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
-        self._allocated: set = set()
+        self._refcount: Dict[int, int] = {}
 
     @property
     def num_free(self) -> int:
         return len(self._free)
 
+    def refcount(self, page: int) -> int:
+        """Current holders of ``page`` (0 = free)."""
+        return self._refcount.get(int(page), 0)
+
     def alloc(self, n: int) -> List[int]:
-        """``n`` pages, or :class:`CacheOutOfPages` — all-or-nothing,
-        so a failed admission never leaks a partial allocation."""
+        """``n`` pages at refcount 1, or :class:`CacheOutOfPages` —
+        all-or-nothing, so a failed admission never leaks a partial
+        allocation."""
         if n > len(self._free):
             raise CacheOutOfPages(
                 f"need {n} pages, {len(self._free)} free "
                 f"(pool {self.num_pages}, 1 reserved)")
         pages = [self._free.pop() for _ in range(n)]
-        self._allocated.update(pages)
+        for p in pages:
+            self._refcount[p] = 1
         return pages
 
+    def share(self, pages) -> None:
+        """Add one reference to each of ``pages`` (all must be
+        allocated).  The sharer promises READ-ONLY use: nothing in the
+        allocator stops a write, the serving layer's write-target
+        masking does (shared pages cover only positions below every
+        sharer's first write position)."""
+        pages = [int(p) for p in pages]
+        for p in pages:
+            if p not in self._refcount:
+                raise ValueError(
+                    f"page {p} is not allocated — cannot share")
+        for p in pages:
+            self._refcount[p] += 1
+
     def free(self, pages) -> None:
+        """Drop one reference per page; refcount-zero pages return to
+        the free list."""
         for p in pages:
             p = int(p)
             if p == 0:
                 raise ValueError("page 0 is the reserved null page")
-            if p not in self._allocated:
+            if p not in self._refcount:
                 raise ValueError(f"page {p} is not allocated "
                                  "(double free?)")
-            self._allocated.remove(p)
-            self._free.append(p)
+            self._refcount[p] -= 1
+            if self._refcount[p] == 0:
+                del self._refcount[p]
+                self._free.append(p)
+
+
+@dataclasses.dataclass
+class AdmitResult:
+    """What an admission reused from the prefix cache.
+
+    ``matched_tokens`` of the prompt are already present in shared
+    pages (prefill compute for whole chunks below this mark can be
+    skipped); ``shared_pages`` of the slot's table row point at
+    read-only pages other holders also reference; ``copied_page`` is
+    the ``(src, dst)`` physical pair the caller must copy on device
+    (:func:`copy_pages`) when the match ended mid-page — the
+    copy-on-write tail."""
+
+    slot: int
+    matched_tokens: int = 0
+    shared_pages: int = 0
+    copied_page: Optional[Tuple[int, int]] = None
+    #: the prompt's full-page cumulative hashes, computed during the
+    #: match — hand them back to :meth:`PagedKVCache.register_prefix`
+    #: so registration does not re-hash the prompt
+    page_hashes: Optional[List[bytes]] = None
 
 
 class PagedKVCache:
@@ -164,7 +224,25 @@ class PagedKVCache:
     page-table and length mirrors the driver ships to the device each
     step.  Device pools live separately (:func:`init_pools`) — they are
     step-function state, threaded through jit; this object is the
-    bookkeeping that decides WHERE in those pools each slot writes."""
+    bookkeeping that decides WHERE in those pools each slot writes.
+
+    **Prefix caching**: the cache keeps a prefix index — a cumulative
+    hash of token ids per FULL page (``h_i = sha1(h_{i-1} || page_i
+    tokens)``) mapping to the physical page that holds those tokens'
+    K/V.  ``admit(prompt_tokens=...)`` longest-matches the new prompt
+    against it: matched full pages are SHARED read-only (refcount++),
+    only the remainder is freshly allocated, and the returned
+    :class:`AdmitResult` tells the scheduler which prefill chunks it
+    may skip.  The last prompt token is never matched — its logits
+    seed generation — so a whole-prompt match shares all pages but the
+    one holding that token, which is COPIED instead (``copied_page``).
+    ``register_prefix`` (call after prefill has written the prompt)
+    adds a slot's full prompt pages to the index with the index itself
+    holding one reference, so registered pages survive the slot's
+    retirement as reusable cache; eviction is pure refcount GC — when
+    an admission runs short of pages, leaf index entries whose ONLY
+    holder is the index are unregistered oldest-first and their pages
+    freed."""
 
     def __init__(self, config: KVCacheConfig):
         self.config = config
@@ -173,12 +251,105 @@ class PagedKVCache:
             (config.max_seqs, config.pages_per_seq), np.int32)
         self.lengths = np.zeros((config.max_seqs,), np.int32)
         self._slot_pages: Dict[int, List[int]] = {}
+        # cumulative page hash -> {"page", "parent" hash, "children"}
+        self._prefix: Dict[bytes, Dict[str, Any]] = {}
+        # slot -> pages the slot references WITHOUT owning a table-row
+        # entry for (the copy-on-write SOURCE page: it must stay
+        # allocated until the device copy has certainly happened, i.e.
+        # the slot's lifetime — eviction or reuse before the copy would
+        # silently corrupt the clone)
+        self._extra_refs: Dict[int, List[int]] = {}
 
-    def admit(self, slot: int, total_tokens: int) -> None:
+    # ------------------------------------------------------ prefix index
+    def _page_hashes(self, prompt_tokens) -> List[bytes]:
+        """Cumulative hashes of the prompt's FULL pages (page i's hash
+        covers tokens ``[0, (i+1) * page_size)`` — a page's identity is
+        its whole history, so two pages hash equal iff every token
+        before and inside them matches)."""
+        import hashlib
+
+        ps = self.config.page_size
+        toks = [int(t) for t in prompt_tokens]
+        hashes, h = [], hashlib.sha1()
+        for i in range(len(toks) // ps):
+            h.update(np.asarray(toks[i * ps: (i + 1) * ps],
+                                np.int64).tobytes())
+            hashes.append(h.digest())
+        return hashes
+
+    @property
+    def prefix_index_size(self) -> int:
+        return len(self._prefix)
+
+    def _evict_prefix(self, n: int) -> int:
+        """Refcount GC: unregister up to ``n`` index entries whose page
+        the index is the ONLY holder of (leaf entries first — an inner
+        entry stays while a longer chain built on it survives), freeing
+        their pages.  Returns how many pages were freed."""
+        freed, progress = 0, True
+        while freed < n and progress:
+            progress = False
+            for h in list(self._prefix):
+                e = self._prefix[h]
+                if e["children"] == 0 and \
+                        self.allocator.refcount(e["page"]) == 1:
+                    del self._prefix[h]
+                    if e["parent"] is not None:
+                        self._prefix[e["parent"]]["children"] -= 1
+                    self.allocator.free([e["page"]])
+                    freed += 1
+                    progress = True
+                    if freed >= n:
+                        break
+        return freed
+
+    def register_prefix(self, slot: int, prompt_tokens,
+                        hashes: Optional[List[bytes]] = None) -> int:
+        """Add ``slot``'s full prompt pages to the prefix index (call
+        AFTER prefill has written them — the index vouches that the
+        page holds those tokens' K/V).  The index takes one reference
+        per newly registered page, so the pages outlive the slot.
+        Pages whose hash is already indexed are skipped (first writer
+        wins; the content is bit-identical by construction).  Returns
+        the number of pages newly registered.  ``hashes`` (the
+        ``AdmitResult.page_hashes`` from this slot's admission) skips
+        re-hashing the prompt."""
+        if slot not in self._slot_pages:
+            raise ValueError(f"slot {slot} is not admitted")
+        pages = self._slot_pages[slot]
+        if hashes is None:
+            hashes = self._page_hashes(prompt_tokens)
+        added, parent = 0, None
+        for i, h in enumerate(hashes):
+            if h not in self._prefix:
+                self.allocator.share([pages[i]])
+                self._prefix[h] = {"page": pages[i], "parent": parent,
+                                   "children": 0}
+                if parent is not None:
+                    self._prefix[parent]["children"] += 1
+                added += 1
+            parent = h
+        return added
+
+    # ------------------------------------------------------------- admit
+    def admit(self, slot: int, total_tokens: int,
+              prompt_tokens=None) -> AdmitResult:
         """Reserve pages for a sequence of up to ``total_tokens``
         (prompt + generation budget) in ``slot``.  Raises
-        :class:`CacheOutOfPages` (backpressure) without side effects;
-        a previously retired slot's row is guaranteed null-paged."""
+        :class:`CacheOutOfPages` (backpressure) without allocating
+        anything (a failed admission may still have GC'd index-only
+        cache pages — that is the eviction working, not a leak); a
+        previously retired slot's row is guaranteed null-paged.
+
+        With ``prompt_tokens``, the prompt is longest-matched against
+        the prefix index and matched full pages are shared instead of
+        allocated (see the class docstring); the result reports what
+        was reused.  The caller MUST honor the contract: no writes at
+        positions below ``matched_tokens``, and the ``copied_page``
+        device copy happens before any attend touches the slot.  The
+        copy's SOURCE page is referenced by the slot until retirement,
+        so no later admission or eviction can recycle it out from
+        under a pending copy."""
         cfg = self.config
         if slot in self._slot_pages:
             raise ValueError(f"slot {slot} is already admitted")
@@ -186,19 +357,67 @@ class PagedKVCache:
             raise ValueError(
                 f"sequence of {total_tokens} tokens exceeds the slot "
                 f"bound {cfg.max_len} (pages_per_seq * page_size)")
-        pages = self.allocator.alloc(cfg.tokens_to_pages(total_tokens))
+        n_pages = cfg.tokens_to_pages(total_tokens)
+
+        matched_pages: List[int] = []
+        matched_tokens, cow_src, hashes = 0, None, None
+        if prompt_tokens is not None:
+            plen = len(prompt_tokens)
+            hashes = self._page_hashes(prompt_tokens)
+            for h in hashes:
+                e = self._prefix.get(h)
+                if e is None:
+                    break
+                matched_pages.append(e["page"])
+            matched_tokens = len(matched_pages) * cfg.page_size
+            if matched_tokens >= plen:
+                # never match the whole prompt: the last token's logits
+                # seed generation, so it is always recomputed — the
+                # page holding it is copied, not shared
+                matched_tokens = plen - 1
+                cow_src = matched_pages.pop()
+
+        # matched pages AND the CoW source are referenced FIRST so the
+        # eviction below can never free (and the alloc never re-issue)
+        # a page this admission is about to read
+        protect = matched_pages + (
+            [cow_src] if cow_src is not None else [])
+        self.allocator.share(protect)
+        n_fresh = n_pages - len(matched_pages)
+        try:
+            short = n_fresh - self.allocator.num_free
+            if short > 0:
+                self._evict_prefix(short)
+            fresh = self.allocator.alloc(n_fresh)
+        except CacheOutOfPages:
+            self.allocator.free(protect)
+            raise
+        pages = matched_pages + fresh
+        copied = (cow_src, fresh[0]) if cow_src is not None else None
+        if cow_src is not None:
+            # the slot keeps its source reference until retirement:
+            # the device copy is guaranteed a live, unrecycled source
+            # for as long as the slot exists
+            self._extra_refs[slot] = [cow_src]
         self._slot_pages[slot] = pages
         row = np.zeros((cfg.pages_per_seq,), np.int32)
         row[: len(pages)] = pages
         self.page_table[slot] = row
         self.lengths[slot] = 0
+        return AdmitResult(
+            slot=slot, matched_tokens=matched_tokens,
+            shared_pages=len(matched_pages), copied_page=copied,
+            page_hashes=hashes)
 
     def retire(self, slot: int) -> None:
-        """Return the slot's pages to the pool and null its table row
-        (so a stale read through the old row hits the null page, never
-        another request's data)."""
+        """Drop the slot's references (refcount-zero pages return to
+        the pool — shared pages other slots or the prefix index still
+        hold stay allocated) and null its table row (so a stale read
+        through the old row hits the null page, never another
+        request's data)."""
         pages = self._slot_pages.pop(slot)
         self.allocator.free(pages)
+        self.allocator.free(self._extra_refs.pop(slot, []))
         self.page_table[slot] = 0
         self.lengths[slot] = 0
 
@@ -234,6 +453,26 @@ def init_pools(config: KVCacheConfig) -> Dict[str, jnp.ndarray]:
         pools["k_scales"] = jnp.ones(sshape, jnp.float32)
         pools["v_scales"] = jnp.ones(sshape, jnp.float32)
     return pools
+
+
+def copy_pages(
+    pools: Dict[str, jnp.ndarray],
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+) -> Dict[str, jnp.ndarray]:
+    """Copy physical pages ``src -> dst`` across every layer and every
+    pool buffer (K, V and, when quantized, their scales) — the
+    copy-on-write an admission whose prefix match ended mid-page needs:
+    the shared source page stays read-only for its other holders while
+    the destination becomes the new slot's private tail.
+
+    ``pools`` is the full :func:`init_pools` dict (leading layer axis);
+    ``src``/``dst`` are ``(n,)`` int32 physical page ids.  Shape-stable
+    and pure — jit it once; the per-admission cost is one ``n``-page
+    gather+scatter."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    return {k: v.at[:, dst].set(v[:, src]) for k, v in pools.items()}
 
 
 def write_targets(

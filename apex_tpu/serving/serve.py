@@ -2,33 +2,67 @@
 slots, so the decode step compiles ONCE and never again.
 
 The driver's contract with XLA is the whole design: every device
-computation it issues — the prefill step and the decode step — has a
-single static shape (``max_seqs`` slots, ``max_prompt_len`` prompt
-window, one paged cache), and request churn only changes CONTENTS
-(page-table rows, length counters, per-slot budgets).  Admissions and
-retirements therefore cost a few small host→device transfers, never a
-recompile — ``tests/test_serving.py`` proves it with a compile-counting
-spy across three request generations.
+computation it issues — the prefill step (monolithic or chunked) and
+the decode step — has a single static shape (``max_seqs`` slots,
+``max_prompt_len`` prompt window / ``prefill_chunk`` tokens per chunk,
+one paged cache), and request churn only changes CONTENTS (page-table
+rows, length counters, per-slot budgets, chunk offsets).  Admissions
+and retirements therefore cost a few small host→device transfers,
+never a recompile — ``tests/test_serving.py`` proves it with a
+compile-counting spy across request generations, chunk counts and
+prefix-hit patterns.
+
+Two prompt-ingestion modes:
+
+- **monolithic** (``prefill_chunk=None``, the PR 9 behavior): an
+  admission runs ONE prefill over the whole padded prompt through the
+  training attention ladder.  Simple, but every decoding slot stalls
+  for the full prompt — the stop-the-world cost chunking exists to
+  bound.
+- **chunked** (``prefill_chunk=C`` + the model's chunk step): prompt
+  ingestion is split into fixed ``C``-token chunks driven through
+  ``fmha_decode``'s small-s_q path, and each serving step composes a
+  token budget of [one decode token for every active slot + at most
+  ONE prefill chunk] — Sarathi-style, so a new request's TTFT and the
+  running requests' inter-token latency are BOTH bounded by the chunk
+  size instead of the prompt length.  Chunk boundaries are absolute
+  (chunk k covers positions ``[k*C, (k+1)*C)``), which is what makes
+  prefix-cache hits bit-identical to cold admissions (see
+  ``GPTModel.prefill_chunk``).
+
+**Prefix caching** (``prefix_cache=True``, chunked mode only): the
+cache's prefix index (``kv_cache.py``) longest-matches each admitted
+prompt's full pages against previously served prompts; matched pages
+are SHARED read-only into the new slot's page table (the decode kernel
+takes arbitrary page tables — sharing is free at kernel level), fully
+matched chunks are skipped outright, and a match ending mid-page is
+resolved by one device page copy (copy-on-write at admit).  The last
+prompt token is never matched — its logits seed generation.  Retired
+slots drop their references; registered pages survive as reusable
+cache until the refcount GC evicts them for a page-starved admission.
 
 Loop anatomy (:meth:`ContinuousBatcher.run`):
 
 1. **admit** — while a slot is free, a request is queued, and the page
    allocator has room (``CacheOutOfPages`` is backpressure, not an
-   error): reserve pages for prompt + budget, run the prefill step
-   (the TRAINING attention ladder over the padded prompt — prefill is
-   a compute-bound s_q == s_k problem, exactly what rungs 1–3 are
-   measured for), which writes the prompt's K/V into the slot's pages
-   and samples the first token.
-2. **decode** — a window of ``harvest_every`` fused decode steps.  The
-   per-slot state (current token, length, budget, done flag, PRNG key)
-   lives ON DEVICE and the step updates it functionally: sampled ids
-   feed the next embedding lookup directly, finished slots freeze
-   (their writes target the null page), nothing touches the host.
+   error): reserve pages for prompt + budget (sharing prefix-matched
+   pages), then either run the monolithic prefill now or queue the
+   slot for chunked ingestion.
+2. **window** — up to ``harvest_every`` serving steps.  Each step runs
+   at most one prefill chunk (oldest admission first) and, when any
+   slot has decode budget, one fused decode step for ALL live slots.
+   A slot whose last chunk completes joins the decode of that SAME
+   serving step (its ``since_step`` marks the join, so the harvest
+   counts exactly its own tokens).  Per-slot state (current token, length, budget, done
+   flag, sampling key) lives ON DEVICE and the step updates it
+   functionally: sampled ids feed the next embedding lookup directly,
+   finished slots freeze (their writes target the null page), nothing
+   touches the host.
 3. **harvest** — ONE batched ``device_get`` per window (the PR 6
-   async-harvest discipline applied to decode: the window's token
-   stack and the admit-time first-token futures resolve together).
-   The host then truncates each slot's stream at EOS/budget, retires
-   finished slots (pages return to the pool), and goes back to 1.
+   async-harvest discipline: the window's token stack and the pending
+   first-token futures resolve together).  The host then truncates
+   each slot's stream at EOS/budget, retires finished slots (pages
+   return to the pool / stay shared), and goes back to 1.
 
 The trade is explicit: a slot that finishes mid-window decodes garbage
 until the window closes (bounded by ``harvest_every``, and its writes
@@ -36,12 +70,19 @@ stay inside its own reserved pages), in exchange for a decode loop with
 zero per-token host syncs.  Time-to-first-token is likewise quantized
 to the harvest cadence — ``harvest_every=1`` recovers per-step
 reporting at per-step sync cost, the same knob ``MetricsLogger``'s
-``flush_every`` is.
+``flush_every`` is — while under chunked prefill ADMISSION progress is
+chunk-granular (TTFT grows with interleaved decode steps but decoding
+slots never stall for a whole prompt).
 
 Telemetry: ``tlm.prefill`` / ``tlm.decode`` phase scopes wrap the
-dispatches, and ``span`` / ``request_admitted`` / ``request_done``
-events (with TTFT and per-window token counts) land in the metrics
-stream — ``tools/metrics_report.py``'s serving section reads them.
+dispatches, and ``span`` (``prefill`` / ``prefill_chunk`` / ``decode``)
+/ ``request_admitted`` / ``prefix_hit`` / ``request_done`` events land
+in the metrics stream — ``tools/metrics_report.py``'s serving section
+reads them.  ``measure_stall=True`` additionally blocks on each
+prefill dispatch to measure real decode-stall time (``decode_stall_s``
+total / ``max_prefill_stall_s`` worst single stall while decode slots
+were live) — the number the ``_dryrun_chunked_prefill`` gate and the
+bench mixed-load rows compare across modes.
 """
 
 from __future__ import annotations
@@ -55,20 +96,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.serving.kv_cache import CacheOutOfPages, PagedKVCache
+from apex_tpu.serving.kv_cache import (
+    CacheOutOfPages,
+    PagedKVCache,
+    copy_pages,
+)
 from apex_tpu.telemetry.spans import phase
 
 __all__ = ["Request", "Completion", "ContinuousBatcher", "init_carry"]
+
+# shared across batchers: the CoW copy compiles once per pools shape
+# (donated — without donation XLA must preserve the input pools, so a
+# copy-on-write admission would rewrite EVERY pool buffer, GBs at real
+# shapes, instead of one page; self.pools is rebound to the result, the
+# old reference is dead.  Donation is a warning-level no-op on CPU
+# backends; the copy is still correct.)
+_copy_pages_jit = jax.jit(copy_pages, donate_argnums=0)
 
 
 @dataclasses.dataclass
 class Request:
     """One generation request.  ``prompt`` is token ids; generation
-    stops after ``max_new_tokens`` or at the server's ``eos_id``."""
+    stops after ``max_new_tokens`` or at the server's ``eos_id``.
+    ``seed`` (optional) pins the request's sampling stream: every draw
+    folds the request's own key, so a seeded request reproduces its
+    sampled tokens regardless of admission order or slot assignment."""
 
     uid: Any
     prompt: Sequence[int]
     max_new_tokens: int
+    seed: Optional[int] = None
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -91,36 +148,51 @@ class Completion:
 
 def init_carry(max_seqs: int, key: Optional[jnp.ndarray] = None
                ) -> Dict[str, jnp.ndarray]:
-    """The decode step's per-slot device state: all slots idle."""
+    """The decode step's per-slot device state: all slots idle.
+    ``sample_keys`` holds one PRNG key row per slot (overwritten at
+    admission — from ``Request.seed`` when given)."""
     s = max_seqs
+    base = jnp.asarray(
+        key if key is not None else jax.random.PRNGKey(0), jnp.uint32)
     return {
         "tokens": jnp.zeros((s,), jnp.int32),
         "lengths": jnp.zeros((s,), jnp.int32),
         "steps_left": jnp.zeros((s,), jnp.int32),
         "done": jnp.ones((s,), bool),
-        "key": key if key is not None else jax.random.PRNGKey(0),
+        "sample_keys": jnp.broadcast_to(base[None], (s,) + base.shape),
     }
 
 
 class ContinuousBatcher:
-    """Drive prefill/decode step functions over a paged cache.
+    """Drive the serving step functions over a paged cache.
 
     ``prefill_fn(pools, tokens (1, max_prompt_len) i32, length () i32,
     page_row (pages_per_seq,) i32, key) -> (pools, first_token ()
     i32)`` — writes the prompt's K/V and samples the first token (the
-    key is a per-admission fold of the batcher's base key; greedy
-    servers ignore it).
+    key is the request's slot key; greedy servers ignore it).
 
     ``decode_fn(pools, carry, page_table (max_seqs, pages_per_seq) i32)
     -> (pools, carry)`` — one token for every live slot; must freeze
     slots whose ``done`` is set (null-page writes, unchanged token /
     length / budget) and maintain ``done |= sampled == eos or budget
-    exhausted``.  :func:`apex_tpu.models.gpt.GPTModel.decode_fns`
-    builds the canonical pair.
+    exhausted``.
 
-    Both are expected to be jitted ONCE outside; the driver never
+    ``chunk_fn(pools, tokens (C,) i32, start, prompt_len, write_from,
+    page_row, key) -> (pools, first_token, logits)`` — one
+    ``prefill_chunk``-token ingestion step (chunked mode only); the
+    first token / logits are meaningful on the chunk containing the
+    last prompt token.  :func:`apex_tpu.models.gpt.GPTModel.decode_fns`
+    builds the canonical set.
+
+    All are expected to be jitted ONCE outside; the driver never
     changes a shape.  ``logger`` is an optional
     :class:`~apex_tpu.telemetry.MetricsLogger` for span/request events.
+    ``prefix_cache=True`` (chunked mode only) shares identical prompt
+    prefixes across requests through the cache's refcounted prefix
+    index.  ``measure_stall=True`` blocks on prefill dispatches to
+    fill the ``decode_stall_s`` / ``max_prefill_stall_s`` counters
+    (real wall time, for the bench/dryrun comparisons; off by default
+    to keep dispatches async).
     """
 
     def __init__(
@@ -135,6 +207,10 @@ class ContinuousBatcher:
         eos_id: Optional[int] = None,
         key: Optional[jnp.ndarray] = None,
         logger: Optional[Any] = None,
+        chunk_fn: Optional[Callable] = None,
+        prefill_chunk: Optional[int] = None,
+        prefix_cache: bool = False,
+        measure_stall: bool = False,
     ):
         if harvest_every < 1:
             raise ValueError("harvest_every must be >= 1")
@@ -151,8 +227,32 @@ class ContinuousBatcher:
                 f"{fn_eos!r} but the batcher truncates at {eos_id!r} — "
                 "pass the same eos_id to decode_fns() and "
                 "ContinuousBatcher()")
+        if (prefill_chunk is None) != (chunk_fn is None):
+            raise ValueError(
+                "chunked prefill needs BOTH chunk_fn and prefill_chunk "
+                "(decode_fns(prefill_chunk=C) builds the pair)")
+        if prefill_chunk is not None and int(prefill_chunk) < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        fn_chunk = getattr(chunk_fn, "prefill_chunk", _unset)
+        if chunk_fn is not None and fn_chunk is not _unset and \
+                int(fn_chunk) != int(prefill_chunk):
+            raise ValueError(
+                f"prefill_chunk mismatch: chunk_fn was compiled for "
+                f"{fn_chunk}-token chunks but the batcher schedules "
+                f"{prefill_chunk}-token chunks")
+        if prefix_cache and prefill_chunk is None:
+            raise ValueError(
+                "prefix_cache requires chunked prefill (the monolithic "
+                "prefill recomputes every position and cannot skip "
+                "matched chunks)")
         self.prefill_fn = prefill_fn
         self.decode_fn = decode_fn
+        self.chunk_fn = chunk_fn
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else int(prefill_chunk))
+        self.prefix_cache = bool(prefix_cache)
+        self.measure_stall = bool(measure_stall)
         self.cache = cache
         self.pools = pools
         self.max_prompt_len = int(max_prompt_len)
@@ -164,20 +264,74 @@ class ContinuousBatcher:
                           else jax.random.PRNGKey(0))
         self._n_admits = 0
         self._meta: Dict[int, dict] = {}      # slot -> request meta
+        self._prefilling: "collections.OrderedDict[int, dict]" = \
+            collections.OrderedDict()         # slot -> chunk progress
         self._first_tok: Dict[int, jnp.ndarray] = {}
         self.completions: Dict[Any, Completion] = {}
         self.steps = 0
         self.windows = 0
+        self.prefill_chunks = 0
+        #: prefill wall time spent while >= 1 decoding slot was live
+        #: (total, and the worst single stall) — meaningful when
+        #: ``measure_stall`` blocked on the dispatches
+        self.decode_stall_s = 0.0
+        self.max_prefill_stall_s = 0.0
+        #: logits of the most recent completed prefill's last prompt
+        #: token (chunked mode) — the bit-identity seam the prefix-hit
+        #: gates compare across cold/hit admissions
+        self.last_prefill_logits: Optional[jnp.ndarray] = None
+        self.prefix_stats = {
+            "admissions": 0, "hits": 0, "matched_tokens": 0,
+            "shared_pages": 0, "tokens_skipped": 0, "copied_pages": 0,
+        }
 
     # ------------------------------------------------------------ events
     def _event(self, kind: str, **fields) -> None:
         if self.logger is not None:
             self.logger.event(kind, **fields)
 
+    def _note_stall(self, dur_s: float) -> None:
+        """Account prefill work that ran while decode slots were live
+        — the stall the chunk budget exists to bound."""
+        if any(m["finished"] is None for m in self._meta.values()):
+            self.decode_stall_s += dur_s
+            self.max_prefill_stall_s = max(
+                self.max_prefill_stall_s, dur_s)
+
+    def _slot_key(self, req: Request) -> jnp.ndarray:
+        """The request's sampling key: its own seed when given, else a
+        fold of the server key by admission index."""
+        if req.seed is not None:
+            return jax.random.PRNGKey(int(req.seed))
+        return jax.random.fold_in(self._base_key, self._n_admits)
+
+    def _slot_live(self, slot: int, first, req: Request, plen: int,
+                   t_admit: float, skey) -> None:
+        """Prefill finished: flip the slot into the decoding set."""
+        budget_left = req.max_new_tokens - 1
+        c = self.carry
+        self.carry = {
+            "tokens": c["tokens"].at[slot].set(first),
+            "lengths": c["lengths"].at[slot].set(plen),
+            "steps_left": c["steps_left"].at[slot].set(budget_left),
+            "done": c["done"].at[slot].set(budget_left <= 0),
+            "sample_keys": c["sample_keys"].at[slot].set(
+                jnp.asarray(skey, jnp.uint32)),
+        }
+        self._first_tok[slot] = first
+        self._meta[slot] = {
+            "req": req, "tokens": [], "t_admit": t_admit,
+            "t_first": None, "finished": None,
+            # decode steps before this mark predate the slot's join —
+            # the harvest must not read them (mid-window chunked joins)
+            "since_step": self.steps,
+        }
+
     # ------------------------------------------------------------- admit
     def _admit(self, queue) -> None:
         cfg = self.cache.config
-        free = [s for s in range(cfg.max_seqs) if s not in self._meta]
+        free = [s for s in range(cfg.max_seqs)
+                if s not in self._meta and s not in self._prefilling]
         for slot in free:
             if not queue:
                 break
@@ -188,69 +342,178 @@ class ContinuousBatcher:
                     f"prompt of {plen} tokens exceeds max_prompt_len "
                     f"{self.max_prompt_len}")
             try:
-                self.cache.admit(slot, plen + req.max_new_tokens)
+                res = self.cache.admit(
+                    slot, plen + req.max_new_tokens,
+                    prompt_tokens=(req.prompt if self.prefix_cache
+                                   else None))
             except CacheOutOfPages:
                 break                       # backpressure: wait for pages
             queue.popleft()
-            toks = np.zeros((1, self.max_prompt_len), np.int32)
-            toks[0, :plen] = np.asarray(req.prompt, np.int32)
-            page_row = jnp.asarray(self.cache.page_table[slot])
-            admit_key = jax.random.fold_in(self._base_key,
-                                           self._n_admits)
+            skey = self._slot_key(req)
             self._n_admits += 1
-            with phase("prefill"):
-                t0 = time.perf_counter()
-                self.pools, first = self.prefill_fn(
-                    self.pools, jnp.asarray(toks),
-                    jnp.int32(plen), page_row, admit_key)
-                dispatch_s = time.perf_counter() - t0
-            self.cache.lengths[slot] = plen
-            budget_left = req.max_new_tokens - 1
-            c = self.carry
-            self.carry = {
-                "tokens": c["tokens"].at[slot].set(first),
-                "lengths": c["lengths"].at[slot].set(plen),
-                "steps_left": c["steps_left"].at[slot].set(budget_left),
-                "done": c["done"].at[slot].set(budget_left <= 0),
-                "key": c["key"],
-            }
-            self._first_tok[slot] = first
-            self._meta[slot] = {
-                "req": req, "tokens": [], "t_admit": time.perf_counter(),
-                "t_first": None, "finished": None,
-            }
+            t_admit = time.perf_counter()
+            page_row = jnp.asarray(self.cache.page_table[slot])
             self._event("request_admitted", uid=req.uid, slot=slot,
                         prompt_tokens=plen,
                         budget=req.max_new_tokens)
+            if self.prefill_chunk is not None:
+                self._admit_chunked(slot, req, res, skey, t_admit,
+                                    page_row)
+                continue
+            # ---- monolithic PR 9 path: one prefill over the padded
+            # prompt, the slot joins decode immediately
+            toks = np.zeros((1, self.max_prompt_len), np.int32)
+            toks[0, :plen] = np.asarray(req.prompt, np.int32)
+            with phase("prefill"):
+                if self.measure_stall:
+                    # drain the in-order device queue first, so the
+                    # measured stall is THIS prefill's work, not the
+                    # previously dispatched steps it queued behind
+                    jax.block_until_ready(self.carry["tokens"])
+                t0 = time.perf_counter()
+                self.pools, first = self.prefill_fn(
+                    self.pools, jnp.asarray(toks),
+                    jnp.int32(plen), page_row, skey)
+                if self.measure_stall:
+                    jax.block_until_ready(first)
+                dispatch_s = time.perf_counter() - t0
+            self._note_stall(dispatch_s)
+            self.cache.lengths[slot] = plen
+            self._slot_live(slot, first, req, plen, t_admit, skey)
             self._event("span", span="prefill", slot=slot,
                         tokens=plen, dispatch_s=round(dispatch_s, 6))
 
+    def _admit_chunked(self, slot, req, res, skey, t_admit,
+                       page_row) -> None:
+        C = self.prefill_chunk
+        plen = len(req.prompt)
+        if res.copied_page is not None:
+            # copy-on-write: the prefix match ended inside this page —
+            # the shared source stays read-only for its other holders,
+            # the copy becomes the slot's private tail
+            src, dst = res.copied_page
+            self.pools = _copy_pages_jit(
+                self.pools, jnp.asarray([src], jnp.int32),
+                jnp.asarray([dst], jnp.int32))
+        n_chunks = -(-plen // C)
+        toks = np.zeros((n_chunks * C,), np.int32)
+        toks[:plen] = np.asarray(req.prompt, np.int32)
+        first_chunk = res.matched_tokens // C
+        self._prefilling[slot] = {
+            "req": req, "toks": toks, "plen": plen,
+            "next_chunk": first_chunk,
+            "write_from": res.matched_tokens,
+            "skipped": first_chunk * C,
+            # admission already hashed the prompt; registration reuses
+            "hashes": res.page_hashes,
+            "key": skey, "t_admit": t_admit, "chunk_s": 0.0,
+            "page_row": page_row,
+        }
+        if self.prefix_cache:
+            st = self.prefix_stats
+            st["admissions"] += 1
+            if res.matched_tokens:
+                st["hits"] += 1
+            st["matched_tokens"] += res.matched_tokens
+            st["shared_pages"] += res.shared_pages
+            st["tokens_skipped"] += first_chunk * C
+            if res.copied_page is not None:
+                st["copied_pages"] += 1
+            self._event(
+                "prefix_hit", uid=req.uid, slot=slot,
+                matched_tokens=res.matched_tokens,
+                shared_pages=res.shared_pages,
+                tokens_skipped=first_chunk * C,
+                copied=res.copied_page is not None)
+
+    # ----------------------------------------------------- prefill chunk
+    def _prefill_step(self, slot: int) -> float:
+        """Run ONE chunk of the oldest in-flight admission; on the last
+        chunk the slot joins the decoding set with the sampled first
+        token.  Returns the chunk's dispatch wall time so the window
+        can keep it OUT of the decode span's duration."""
+        st = self._prefilling[slot]
+        C = self.prefill_chunk
+        c0 = st["next_chunk"] * C
+        with phase("prefill"):
+            if self.measure_stall:
+                # drain the queue (see _admit): attribute only this
+                # chunk's work to the stall, not the decode step it
+                # queued behind
+                jax.block_until_ready(self.carry["tokens"])
+            t0 = time.perf_counter()
+            self.pools, tok, logits = self.chunk_fn(
+                self.pools, st["toks"][c0:c0 + C], c0, st["plen"],
+                st["write_from"], st["page_row"], st["key"])
+            if self.measure_stall:
+                jax.block_until_ready(tok)
+            dur = time.perf_counter() - t0
+        self._note_stall(dur)
+        st["chunk_s"] += dur
+        st["next_chunk"] += 1
+        self.prefill_chunks += 1
+        self._event("span", span="prefill_chunk", slot=slot,
+                    chunk=st["next_chunk"] - 1, start=c0,
+                    tokens=min(C, st["plen"] - c0),
+                    dispatch_s=round(dur, 6))
+        if st["next_chunk"] * C < st["plen"]:
+            return dur
+        # last chunk: the prompt is fully ingested
+        req = st["req"]
+        del self._prefilling[slot]
+        self.cache.lengths[slot] = st["plen"]
+        if self.prefix_cache:
+            self.cache.register_prefix(slot, req.prompt,
+                                       hashes=st["hashes"])
+        self.last_prefill_logits = logits
+        self._slot_live(slot, tok, req, st["plen"], st["t_admit"],
+                        st["key"])
+        self._event("span", span="prefill", slot=slot,
+                    tokens=st["plen"] - st["skipped"],
+                    dispatch_s=round(st["chunk_s"], 6))
+        return dur
+
     # ------------------------------------------------------------ decode
+    def _window_budget(self, base: int) -> int:
+        """Decode steps someone can still use: the longest remaining
+        budget among live slots, net of the steps each already took
+        this window (generated-so-far counts the admit-time first
+        token while it is still an unharvested future)."""
+        budget = 0
+        for s, m in self._meta.items():
+            if m["finished"] is not None:
+                continue
+            taken = self.steps - max(m.get("since_step", base), base)
+            rem = (m["req"].max_new_tokens - len(m["tokens"])
+                   - (1 if s in self._first_tok else 0) - taken)
+            budget = max(budget, rem)
+        return budget
+
     def _decode_window(self) -> None:
-        cfg = self.cache.config
+        base = self.steps
         page_table = jnp.asarray(self.cache.page_table)
-        active = [s for s, m in self._meta.items()
-                  if m["finished"] is None]
-        # only decode as far as someone can still use: the longest
-        # remaining budget among live slots bounds useful steps
-        # (generated-so-far counts the admit-time first token while it
-        # is still an unharvested future)
-        budget = max(
-            (self._meta[s]["req"].max_new_tokens
-             - len(self._meta[s]["tokens"])
-             - (1 if s in self._first_tok else 0)) for s in active
-        ) if active else 0
-        steps = min(self.harvest_every, max(budget, 0))
         window: List[jnp.ndarray] = []
         t0 = time.perf_counter()
-        with phase("decode"):
-            for _ in range(steps):
-                self.pools, self.carry = self.decode_fn(
-                    self.pools, self.carry, page_table)
+        chunk_s = 0.0          # interleaved prefill time, kept OUT of
+        for _ in range(self.harvest_every):  # the decode span's dur_s
+            # the step's token budget: at most ONE prefill chunk ...
+            did_chunk = False
+            if self._prefilling:
+                chunk_s += self._prefill_step(
+                    next(iter(self._prefilling)))
+                did_chunk = True
+            # ... plus one decode token for every live slot
+            if self._window_budget(base) > 0:
+                with phase("decode"):
+                    self.pools, self.carry = self.decode_fn(
+                        self.pools, self.carry, page_table)
                 window.append(self.carry["tokens"])
                 self.steps += 1
+            elif not did_chunk:
+                break
         # ---- harvest: ONE batched resolve for the whole window plus
         # every pending admit-time first token
+        steps = len(window)
         firsts = {s: self._first_tok.pop(s) for s in list(self._first_tok)}
         stacked = jnp.stack(window) if window else None
         harvested, firsts_h, done_h = jax.device_get(
@@ -271,6 +534,8 @@ class ContinuousBatcher:
             for slot, m in self._meta.items():
                 if m["finished"] is not None:
                     continue
+                if base + i < m.get("since_step", base):
+                    continue        # slot joined mid-window, later step
                 tok = int(harvested[i, slot])
                 m["tokens"].append(tok)
                 kept += 1
@@ -284,10 +549,15 @@ class ContinuousBatcher:
         # mid-window decode garbage for the rest of it, and counting
         # that would inflate the serving summary's tokens/s exactly in
         # the ragged-finish steady state the metric exists to measure
+        # dur_s excludes the interleaved chunk dispatches: the serving
+        # summary's decode tokens/s and inter-token-latency fields are
+        # computed from this span, and charging prefill work to them
+        # would skew exactly the chunked-vs-monolithic comparison they
+        # exist to make (the chunk time is its own prefill_chunk span)
         self._event(
             "span", span="decode", steps=steps,
             slots=len(self._meta), tokens=kept,
-            dur_s=round(t_h - t0, 6),
+            dur_s=round(max(t_h - t0 - chunk_s, 0.0), 6),
         )
 
         # ---- retire: device `done` and host finish detection agree by
@@ -324,11 +594,12 @@ class ContinuousBatcher:
     def run(self, requests: Sequence[Request]) -> Dict[Any, Completion]:
         """Serve ``requests`` to completion; returns ``uid ->``
         :class:`Completion`.  Re-entrant: call again with more
-        requests — the cache, pools and compiled steps are reused."""
+        requests — the cache, pools, prefix index and compiled steps
+        are reused."""
         queue = collections.deque(requests)
-        while queue or self._meta:
+        while queue or self._meta or self._prefilling:
             self._admit(queue)
-            if not self._meta:
+            if not self._meta and not self._prefilling:
                 if queue:
                     raise CacheOutOfPages(
                         "no slot can ever admit the next request "

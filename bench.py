@@ -980,7 +980,10 @@ def child_decode():
     whole ``GPTModel.decode_step`` pipeline) at decode batch
     {1, 8, 64, 256} for fp32 / bf16 / int8-KV caches, plus one mixed
     prefill+decode row (a continuous-batching window that admits a
-    prompt mid-stream).  Runs the flagship CPU-dryrun GPT shape on ONE
+    prompt mid-stream) and the MIXED-LOAD rows: TTFT p50/p95 and
+    decode-stall time of long-prompt arrivals with chunked prefill on
+    vs off vs on-with-shared-prefix (prefix-cache hits) at decode
+    batch {8, 64, 256}.  Runs the flagship CPU-dryrun GPT shape on ONE
     device so "per chip" is honest; always a CPU measurement here, so
     per the PR 3 convention ``vs_baseline`` is null — the row tracks
     that the serving stack stays runnable and how the variants rank,
@@ -1004,7 +1007,10 @@ def child_decode():
         devices=jax.devices()[:1])
     model = GPTModel(GPTConfig(
         vocab_size=VOCAB, num_layers=LAYERS, hidden_size=HIDDEN,
-        num_attention_heads=HEADS, max_position_embeddings=512,
+        num_attention_heads=HEADS,
+        # the mixed-load rows admit 520-token prompts (512-token
+        # shared prefix + tail) whose cache rounds up to 17 pages
+        max_position_embeddings=1024,
         compute_dtype=jnp.float32, attention_impl="xla", remat=False,
     ))
     params = model.init(jax.random.PRNGKey(0))
@@ -1045,7 +1051,7 @@ def child_decode():
                 "steps_left": carry["steps_left"].at[slot].set(
                     STEPS + WARMUP + 2),
                 "done": carry["done"].at[slot].set(False),
-                "key": carry["key"],
+                "sample_keys": carry["sample_keys"],
             }
         pt = jnp.asarray(cache.page_table)
         for _ in range(WARMUP):
@@ -1088,6 +1094,122 @@ def child_decode():
         "note": "b=8 bf16: one prompt admission per "
                 f"{STEPS}-step decode window",
     }
+
+    # ---- mixed-load rows: long-prompt arrivals against a full batch
+    # of already-decoding slots, chunked prefill OFF vs ON vs ON with
+    # a shared 512-token prefix (prefix-cache hits).  Measures TTFT
+    # p50/p95 of the long arrivals and the decode stall their prefills
+    # impose (total + worst single stall while decode slots were
+    # live), recorded against the batch-256 cliff above (bf16 tokens/s
+    # peaks at b=64 and FALLS at 256) so the next TPU capture
+    # quantifies the stall-free win where the cliff lives.  All three
+    # variants serve IDENTICAL long prompts (shared 512-token prefix +
+    # distinct tails); only the scheduler mode changes.
+    from apex_tpu.serving.serve import ContinuousBatcher, Request
+
+    import numpy as np
+
+    MIX_PREFIX, MIX_TAIL, CHUNK = 512, 8, 256
+    LONGS, SHORT_NEW, LONG_NEW = 4, 24, 8
+    mix_rng = np.random.RandomState(11)
+    shared_prefix = mix_rng.randint(1, VOCAB, (MIX_PREFIX,))
+    long_prompts = [
+        list(map(int, shared_prefix))
+        + list(map(int, mix_rng.randint(1, VOCAB, (MIX_TAIL,))))
+        for _ in range(LONGS)
+    ]
+    short_prompts = [list(map(int, mix_rng.randint(1, VOCAB, (8,))))
+                     for _ in range(256)]
+
+    def run_mixed(batch, chunked, prefix):
+        # the decode STEP's cost is set by the compiled slot width
+        # (fixed shapes), not by how many slots are live — so the
+        # short-decoder count is capped to keep the CPU row affordable
+        # while `batch` still sets the shape whose cliff is measured
+        n_short = min(batch, 32) - 1
+        long_len = MIX_PREFIX + MIX_TAIL
+        pps = -(-(long_len + LONG_NEW) // PAGE)
+        num_pages = 1 + n_short * (-(-(8 + SHORT_NEW) // PAGE)) \
+            + (LONGS + 2) * pps
+        cfg = KVCacheConfig(
+            num_layers=LAYERS, num_heads=HEADS,
+            head_dim=HIDDEN // HEADS, num_pages=num_pages,
+            page_size=PAGE, max_seqs=batch, pages_per_seq=pps,
+            dtype=jnp.bfloat16)
+        fns = model.decode_fns(
+            params, mesh, cfg, max_prompt_len=long_len,
+            prefill_chunk=CHUNK if chunked else None)
+        batcher = ContinuousBatcher(
+            fns.prefill, fns.decode, PagedKVCache(cfg),
+            init_pools(cfg), max_prompt_len=long_len, harvest_every=4,
+            chunk_fn=fns.chunk,
+            prefill_chunk=CHUNK if chunked else None,
+            prefix_cache=prefix, measure_stall=True)
+        # prime: serve the shared prefix once OUTSIDE the measured
+        # window (registers the prefix pages; also pays first-call
+        # compiles), then measure the mixed workload where every long
+        # arrival can hit
+        batcher.run([Request(uid="prime", prompt=long_prompts[0],
+                             max_new_tokens=2)])
+        batcher.decode_stall_s = 0.0
+        batcher.max_prefill_stall_s = 0.0
+        for k in batcher.prefix_stats:
+            batcher.prefix_stats[k] = 0
+        reqs = [Request(uid=f"s{i}", prompt=short_prompts[i],
+                        max_new_tokens=SHORT_NEW)
+                for i in range(n_short)]
+        reqs += [Request(uid=f"L{j}", prompt=long_prompts[j],
+                         max_new_tokens=LONG_NEW)
+                 for j in range(LONGS)]
+        t0 = time.perf_counter()
+        comps = batcher.run(reqs)
+        wall = time.perf_counter() - t0
+        ttfts = sorted(c.ttft_s for uid, c in comps.items()
+                       if str(uid).startswith("L"))
+        pct = lambda q: ttfts[min(len(ttfts) - 1,
+                                  int(round(q * (len(ttfts) - 1))))]
+        row = {
+            "ttft_p50_ms": round(pct(0.50) * 1e3, 2),
+            "ttft_p95_ms": round(pct(0.95) * 1e3, 2),
+            "decode_stall_ms": round(batcher.decode_stall_s * 1e3, 2),
+            "max_prefill_stall_ms": round(
+                batcher.max_prefill_stall_s * 1e3, 2),
+            "wall_ms": round(wall * 1e3, 1),
+        }
+        if chunked:
+            row["prefill_chunks"] = batcher.prefill_chunks
+        if prefix:
+            # rate over the LONG arrivals only: the short decoders'
+            # sub-page prompts are structurally unmatchable and would
+            # dilute the headline with the short/long mix, not the
+            # cache's effectiveness
+            px = batcher.prefix_stats
+            row["prefix_hit_rate_long_arrivals"] = round(
+                px["hits"] / LONGS, 3)
+            row["prefill_tokens_skipped"] = px["tokens_skipped"]
+            row["pages_shared"] = px["shared_pages"]
+        return row
+
+    mixed_load = {}
+    for batch in (8, 64, 256):
+        per = {}
+        for name, chunked, prefix in (
+                ("monolithic", False, False),
+                ("chunked", True, False),
+                ("chunked_prefix", True, True)):
+            per[name] = run_mixed(batch, chunked, prefix)
+            log(f"mixed b{batch} {name}: "
+                f"ttft p95 {per[name]['ttft_p95_ms']} ms, "
+                f"max stall {per[name]['max_prefill_stall_ms']} ms")
+        per["note"] = (
+            f"{min(batch, 32) - 1} short decoders + {LONGS} long "
+            f"arrivals ({MIX_PREFIX}-token shared prefix + {MIX_TAIL} "
+            "tail) at the batch-wide compiled decode shape; stall = "
+            "prefill wall while decode slots were live, queue-drained "
+            "before each measurement; prefix primed out-of-window")
+        mixed_load[str(batch)] = per
+    rows["mixed_load"] = mixed_load
+
     best = max(v["tokens_per_sec_per_chip"]
                for v in rows["bfloat16"].values())
     print(json.dumps({
@@ -1105,7 +1227,9 @@ def child_decode():
         "batches": rows,
         "spec": {"vocab": VOCAB, "layers": LAYERS, "hidden": HIDDEN,
                  "heads": HEADS, "page_size": PAGE, "prompt": PROMPT,
-                 "steps": STEPS, "warmup": WARMUP},
+                 "steps": STEPS, "warmup": WARMUP,
+                 "mixed_prefix": MIX_PREFIX, "mixed_tail": MIX_TAIL,
+                 "prefill_chunk": CHUNK},
     }))
 
 

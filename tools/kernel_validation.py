@@ -981,10 +981,14 @@ def validate_fmha_decode(smoke=False):
     """Decode-tier sweep (the fourth attention rung): the Pallas paged
     decode kernel vs the XLA paged reference across serving shapes —
     batch {1,8,64,256} x cache length {512,2048,8192} x KV dtype
-    {bf16, fp32, int8} — plus the end-to-end gate: GREEDY generation
-    through the full serving stack (paged cache + fmha_decode +
-    continuous batching) must produce token-identical output to the
-    naive full-recompute reference at kv_dtype=None.
+    {bf16, fp32, int8}, plus chunked-prefill cells at s_q in {64, 256}
+    (the scheduler's prompt-ingestion chunk attending over cache + its
+    own just-written pages, held to the same never-lose-to-XLA bar as
+    s_q=1) — plus the end-to-end gate: GREEDY generation through the
+    full serving stack (paged cache + fmha_decode + continuous
+    batching, monolithic AND chunked prefill) must produce
+    token-identical output to the naive full-recompute reference at
+    kv_dtype=None.
 
     Two gates ride these rows in main(): parity (gate 1, relative to
     the XLA path's own error vs the fp32 ground truth — both paths pay
@@ -1117,6 +1121,104 @@ def validate_fmha_decode(smoke=False):
                 })
                 print(json.dumps(results[-1]))
 
+    # ---- chunked-prefill cells: s_q in {64, 256} — the serving
+    # scheduler's prompt-ingestion chunk attends over the prior cache
+    # AND its own just-written pages (write-before-attend), per-row
+    # causal at positions lengths - sq + i.  Same rows, same gates:
+    # parity is gate (1) and the never-lose-to-XLA bar is gate (2) —
+    # the chunk path is explicit dispatch exactly like s_q = 1, so a
+    # losing cell is a kernel bug (likely the VMEM-bounded block_h
+    # pick), not a crossover to move.
+    sqs = [64] if smoke else [64, 256]
+    sq_kvs = ["bfloat16"] if smoke else ["bfloat16", "int8"]
+    for sq in sqs:
+        b, cache = 8, (512 if smoke else 2048)
+        npp = cache // ps
+        pool_pages = 1 + b * npp
+        key = jax.random.PRNGKey(sq)
+        k0, k1, k2, k3 = jax.random.split(key, 4)
+        km = jax.random.normal(k0, (pool_pages, h, ps, d), jnp.bfloat16)
+        vm = jax.random.normal(k1, (pool_pages, h, ps, d), jnp.bfloat16)
+        q = jax.random.normal(k2, (b, h, sq, d), jnp.bfloat16)
+        perm = jax.random.permutation(
+            k3, jnp.arange(1, pool_pages, dtype=jnp.int32))
+        page_table = perm[: b * npp].reshape(b, npp)
+        # ragged: odd sequences' chunks end mid-page (lengths count the
+        # chunk's own just-written tokens, all >= sq)
+        lengths = jnp.where(
+            jnp.arange(b) % 2 == 0, cache, cache - ps // 2 - 1
+        ).astype(jnp.int32)
+        for kv in sq_kvs:
+            if kv == "int8":
+                def q8s(pages):
+                    vals, scales = quantize_rows(
+                        pages.reshape(-1, d).astype(jnp.float32),
+                        kv_block)
+                    return (vals.reshape(pages.shape),
+                            scales.reshape(*pages.shape[:-1], -1))
+
+                kp, ks = q8s(km)
+                vp, vs = q8s(vm)
+            else:
+                kp, vp = km, vm
+                ks = vs = None
+            kwargs = dict(k_scales=ks, v_scales=vs, kv_block=kv_block)
+
+            def fwd_t(impl):
+                return jax.jit(
+                    lambda q, kp, vp: jnp.sum(fmha_decode(
+                        q, kp, vp, page_table, lengths,
+                        implementation=impl, **kwargs,
+                    ).astype(jnp.float32)))
+
+            with jax.default_matmul_precision("highest"):
+                if kv == "int8":
+                    from apex_tpu.ops.attention_decode import (
+                        _dequant_pages,
+                    )
+                    kr = _dequant_pages(kp, ks, kv_block)
+                    vr = _dequant_pages(vp, vs, kv_block)
+                else:
+                    kr, vr = (kp.astype(jnp.float32),
+                              vp.astype(jnp.float32))
+                ref = jax.jit(
+                    lambda q, kr, vr: paged_attention_reference(
+                        q, kr, vr, page_table, lengths))(
+                    q.astype(jnp.float32), kr, vr)
+            out_p = jax.device_get(jax.jit(
+                lambda q, kp, vp: fmha_decode(
+                    q, kp, vp, page_table, lengths,
+                    implementation="pallas", **kwargs))(q, kp, vp))
+            out_x = jax.device_get(jax.jit(
+                lambda q, kp, vp: fmha_decode(
+                    q, kp, vp, page_table, lengths,
+                    implementation="xla", **kwargs))(q, kp, vp))
+            iters = 10 if smoke else 50
+            p_ms = _time(fwd_t("pallas"), q, kp, vp, iters=iters)
+            x_ms = _time(fwd_t("xla"), q, kp, vp, iters=iters)
+            kv_bytes = 2 * b * npp * ps * h * d * \
+                jnp.dtype(kp.dtype).itemsize
+            results.append({
+                "kernel": "fmha_decode",
+                "shape": [b, h, sq, d],
+                "cache_len": cache,
+                "page_size": ps,
+                "dtype": kv,
+                "causal": True,
+                "auto_impl": "pallas",
+                "chunked_prefill": True,
+                "fwd": {
+                    "pallas_ms": round(p_ms, 3),
+                    "xla_ms": round(x_ms, 3),
+                    "speedup": round(x_ms / p_ms, 2),
+                    "decode_gbs": round(
+                        kv_bytes / (p_ms * 1e-3) / 1e9, 1),
+                    "max_err_vs_fp32": _max_err(out_p, ref),
+                    "xla_err_vs_fp32": _max_err(out_x, ref),
+                },
+            })
+            print(json.dumps(results[-1]))
+
     # ---- end-to-end greedy-generation gate: the paged serving stack
     # must reproduce the unpaged full-recompute reference exactly
     import numpy as np
@@ -1145,13 +1247,22 @@ def validate_fmha_decode(smoke=False):
     got = model.generate(params, prompts, plens, new, mesh=mesh,
                          page_size=16, max_seqs=2, harvest_every=4)
     match = all(list(ref_toks[i]) == got[i] for i in range(bgen))
+    # the chunked scheduler must land on the same tokens: 3 chunks per
+    # full prompt (C=8), prefix caching on so the shared admit path is
+    # exercised on hardware too
+    got_c = model.generate(params, prompts, plens, new, mesh=mesh,
+                           page_size=16, max_seqs=2, harvest_every=4,
+                           prefill_chunk=8, prefix_cache=True)
+    match_c = all(list(ref_toks[i]) == got_c[i] for i in range(bgen))
     results.append({
         "kernel": "decode_generation",
         "shape": [bgen, sp, new],
         "dtype": "bfloat16",
         "greedy_match": bool(match),
+        "chunked_greedy_match": bool(match_c),
         "note": "paged serving stack (continuous batching, 2 slots / "
-                "4 requests) vs naive full-recompute greedy reference",
+                "4 requests; monolithic AND chunked+prefix-cache "
+                "prefill) vs naive full-recompute greedy reference",
     })
     print(json.dumps(results[-1]))
     return results
@@ -1293,6 +1404,10 @@ def main():
                 not e.get("greedy_match", True):
             bad.append((e, "paged greedy generation diverged from the "
                            "full-recompute reference"))
+        if e.get("kernel") == "decode_generation" and \
+                not e.get("chunked_greedy_match", True):
+            bad.append((e, "CHUNKED-prefill greedy generation diverged "
+                           "from the full-recompute reference"))
     if True in flag and False in flag:
         # same shipped config on both sides (best-of-sweep could pick
         # different blocks per causality and fake a skip win)

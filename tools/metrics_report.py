@@ -19,9 +19,11 @@ docs/observability.md) and reports what a final tokens/s number cannot:
   counts, interleaved with the step indices they landed between;
 - **serving summary** — when the stream came from a serving run
   (``apex_tpu/serving/serve.py``'s ``tlm.prefill``/``tlm.decode``
-  ``span`` records + ``request_done`` events): per-window decode
-  tokens/s, time-to-first-token stats, inter-token latency
-  percentiles, and request completion counts by reason.
+  ``span`` records + ``request_done``/``prefix_hit`` events):
+  per-window decode tokens/s, time-to-first-token stats, inter-token
+  latency percentiles, request completion counts by reason, chunked-
+  prefill progress (``prefill_chunk`` spans), and the prefix-cache
+  scoreboard (hit rate, pages shared, prefill tokens skipped).
 
 Usage::
 
@@ -89,9 +91,13 @@ def summarize_serving(records: List[dict]) -> Optional[Dict[str, Any]]:
     done = [r for r in records
             if r.get("kind") == "event"
             and r.get("event") == "request_done"]
+    hits = [r for r in records
+            if r.get("kind") == "event"
+            and r.get("event") == "prefix_hit"]
     decode = [r for r in spans if r.get("span") == "decode"
               and r.get("steps")]
     prefill = [r for r in spans if r.get("span") == "prefill"]
+    chunks = [r for r in spans if r.get("span") == "prefill_chunk"]
     if not (decode or prefill or done):
         return None
     out: Dict[str, Any] = {}
@@ -129,6 +135,34 @@ def summarize_serving(records: List[dict]) -> Optional[Dict[str, Any]]:
         ptoks = [int(r["tokens"]) for r in prefill if "tokens" in r]
         if ptoks:
             out["prefill_tokens"] = sum(ptoks)
+    if chunks:
+        cms = [float(r["dispatch_s"]) * 1e3 for r in chunks
+               if "dispatch_s" in r]
+        out["prefill_chunks"] = {
+            "count": len(chunks),
+            "tokens": sum(int(r.get("tokens", 0)) for r in chunks),
+        }
+        if cms:
+            out["prefill_chunks"]["mean_ms"] = round(
+                sum(cms) / len(cms), 3)
+            out["prefill_chunks"]["max_ms"] = round(max(cms), 3)
+    if hits:
+        # the prefix-cache scoreboard: one prefix_hit event lands per
+        # chunked admission (matched_tokens == 0 on a miss)
+        matched = [int(r.get("matched_tokens", 0)) for r in hits]
+        out["prefix_cache"] = {
+            "admissions": len(hits),
+            "hits": sum(1 for m in matched if m > 0),
+            "hit_rate": round(
+                sum(1 for m in matched if m > 0) / len(hits), 4),
+            "matched_tokens": sum(matched),
+            "pages_shared": sum(
+                int(r.get("shared_pages", 0)) for r in hits),
+            "prefill_tokens_skipped": sum(
+                int(r.get("tokens_skipped", 0)) for r in hits),
+            "pages_copied": sum(
+                1 for r in hits if r.get("copied")),
+        }
     if done:
         reasons: Dict[str, int] = {}
         ttfts = []
@@ -241,10 +275,11 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
                       "fused", "buffers", "buffer_bytes",
                       "moment_dtype", "unscale_folded", "self_ms",
                       "gbs",
-                      # serving span / request fields
+                      # serving span / request / prefix-cache fields
                       "span", "steps", "slots", "tokens", "dur_s",
                       "uid", "slot", "reason", "new_tokens",
-                      "ttft_s"):
+                      "ttft_s", "chunk", "start", "matched_tokens",
+                      "shared_pages", "tokens_skipped", "copied"):
                 if k in r:
                     entry[k] = r[k]
             timeline.append(entry)
@@ -334,10 +369,18 @@ def format_report(summary: Dict[str, Any]) -> str:
                 f"p99 {i['p99']} ms")
         if "ttft_s" in sv:
             t = sv["ttft_s"]
+            # honesty note: first tokens surface at harvest boundaries
+            # either way; under chunked prefill ADMISSION additionally
+            # progressed one chunk per serving step, so TTFT includes
+            # the interleaved decode steps (that interleaving is the
+            # point — decode never stalled for a whole prompt)
+            granularity = ("harvest cadence, chunk-granularity "
+                           "admission" if "prefill_chunks" in sv
+                           else "harvest cadence")
             lines.append(
                 f"  time-to-first-token: p50 {t['p50']}s  "
                 f"p95 {t['p95']}s  max {t['max']}s "
-                f"(quantized to the harvest cadence)")
+                f"(quantized to the {granularity})")
         if "requests" in sv:
             r = sv["requests"]
             by = "  ".join(f"{k}={v}"
@@ -347,6 +390,22 @@ def format_report(summary: Dict[str, Any]) -> str:
             lines.append(
                 f"  prefill: {sv['prefill_spans']} admissions, "
                 f"{sv.get('prefill_tokens', '?')} prompt tokens")
+        if "prefill_chunks" in sv:
+            pc = sv["prefill_chunks"]
+            row = (f"  prefill chunks: {pc['count']} "
+                   f"({pc['tokens']} tokens")
+            if "mean_ms" in pc:
+                row += (f", mean {pc['mean_ms']} ms, "
+                        f"max {pc['max_ms']} ms")
+            lines.append(row + ")")
+        if "prefix_cache" in sv:
+            px = sv["prefix_cache"]
+            lines.append(
+                f"  prefix cache: {px['hits']}/{px['admissions']} "
+                f"admissions hit ({px['hit_rate']:.0%}), "
+                f"{px['pages_shared']} pages shared, "
+                f"{px['prefill_tokens_skipped']} prefill tokens "
+                f"skipped, {px['pages_copied']} CoW copies")
     ev = summary.get("events")
     if ev:
         lines.append("events: " + "  ".join(
