@@ -23,6 +23,7 @@ Layout (mirrors the reference's component inventory, see SURVEY.md §2):
 - :mod:`apex_tpu.contrib`        — xentropy, ASP sparsity, MHA modules, …
 - :mod:`apex_tpu.telemetry`      — runtime metrics (async scalar harvesting), subsystem events, phase traces
 - :mod:`apex_tpu.serving`        — inference: paged KV cache, fused sampling, continuous batching
+- :mod:`apex_tpu.fleet`          — multi-replica serving: SLO-aware routing, prefix affinity, failover
 """
 
 __version__ = "0.1.0"
@@ -85,7 +86,7 @@ from apex_tpu import reparameterization  # noqa: E402
 # `apex_tpu.checkpoint`, `apex_tpu.resilience`, `apex_tpu.telemetry`
 # resolve on first attribute access
 _LAZY = ("transformer", "models", "contrib", "ops", "checkpoint",
-         "resilience", "telemetry", "serving")
+         "resilience", "telemetry", "serving", "fleet")
 
 
 def __getattr__(name):
@@ -117,6 +118,7 @@ __all__ = [
     "resilience",
     "telemetry",
     "serving",
+    "fleet",
     "logger",
     "__version__",
 ]

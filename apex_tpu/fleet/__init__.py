@@ -1,0 +1,56 @@
+"""apex_tpu.fleet — multi-replica serving above the continuous batcher.
+
+The scenario layer of the serving stack ("heavy traffic from millions
+of users"): N :class:`~apex_tpu.serving.serve.ContinuousBatcher`
+replicas share ONE set of jitted decode step functions (dp-replicated —
+zero extra compilations) behind one router.  Two modules, one concern
+each:
+
+- :mod:`~apex_tpu.fleet.router` — the :class:`FleetRouter` and its
+  declarative :class:`FleetPolicy`: per-request SLO classes with
+  priority queueing and admission control, prefix-affinity routing
+  keyed on the prefix cache's cumulative page hashes, least-loaded
+  fallback scored from host-mirror load signals (free pages, queue
+  depth, live slots — no new host syncs), and a round-robin baseline.
+- :mod:`~apex_tpu.fleet.failover` — the replayable
+  :class:`RequestLog` and :func:`resume_request`: every request's
+  (prompt, seed, committed tokens) survives its replica, so a killed
+  replica's work re-admits elsewhere with emitted tokens replayed as
+  prompt suffix — token-identical continuations, zero lost requests.
+
+``tools/load_gen.py`` generates deterministic bursty traces and
+replays them through a router; docs/serving.md ("Fleet tier") is the
+guide; the ``_dryrun_fleet`` config and ``tests/test_fleet.py`` gate
+the routing win and the failover contract.
+"""
+
+_LAZY_ATTRS = {
+    "router": "apex_tpu.fleet.router",
+    "failover": "apex_tpu.fleet.failover",
+    "SLOClass": "apex_tpu.fleet.router",
+    "FleetPolicy": "apex_tpu.fleet.router",
+    "Replica": "apex_tpu.fleet.router",
+    "FleetRouter": "apex_tpu.fleet.router",
+    "FleetCompletion": "apex_tpu.fleet.router",
+    "INTERACTIVE": "apex_tpu.fleet.router",
+    "BATCH": "apex_tpu.fleet.router",
+    "LogEntry": "apex_tpu.fleet.failover",
+    "RequestLog": "apex_tpu.fleet.failover",
+    "resume_request": "apex_tpu.fleet.failover",
+}
+
+__all__ = sorted(_LAZY_ATTRS)
+
+
+def __getattr__(name):
+    if name in _LAZY_ATTRS:
+        import importlib
+
+        mod = importlib.import_module(_LAZY_ATTRS[name])
+        val = (mod if name in ("router", "failover")
+               else getattr(mod, name))
+        globals()[name] = val
+        return val
+    raise AttributeError(
+        f"module 'apex_tpu.fleet' has no attribute {name!r}"
+    )

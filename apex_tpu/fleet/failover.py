@@ -1,0 +1,164 @@
+"""Zero-loss replica failover: the replayable request log.
+
+A fleet replica is one :class:`~apex_tpu.serving.serve.ContinuousBatcher`
+— an in-process object whose death (a preempted chip, an injected
+fault) takes its device state, its unharvested window, and its queue
+with it.  What must NOT die with it is the requests, and the insight is
+that a request's whole recoverable state is three host-side values the
+router already handles:
+
+- the original :class:`~apex_tpu.serving.serve.Request` (prompt,
+  budget, seed),
+- the tokens HARVESTED so far (harvest is the commit point — tokens a
+  lost window had produced on device are regenerated, not recovered),
+- which replica currently holds it.
+
+:class:`RequestLog` records exactly that, updated from
+``ContinuousBatcher.progress()`` after every harvest (pure host
+mirrors, no device sync).  On replica death the router re-admits every
+in-flight entry elsewhere via :func:`resume_request`: the committed
+tokens are replayed as a PROMPT SUFFIX and the budget shrinks by their
+count.  This is correct because the serving stack's sampling-key
+schedule folds the slot key with the ABSOLUTE context length (the draw
+after ``L`` context tokens folds ``L`` — ``GPTModel.decode_fns``): a
+replayed prefill over ``prompt + emitted`` lands every token at the
+position it originally held, so the logits match and the continuation
+is token-identical — trivially for greedy, and for seeded sampling
+because the next draw folds the same length into the same
+``Request.seed`` key.  The ``_dryrun_fleet`` drill gates this
+end-to-end: a killed replica's requests all complete elsewhere with
+streams identical to an unkilled run.
+
+The contract's preconditions (the router enforces them at admission):
+
+- the request carries a ``seed`` OR the server is greedy — an
+  unseeded sampled request draws from a server key fold and is NOT
+  replayable;
+- ``len(prompt) + max_new_tokens - 1 <= max_prompt_len`` — the replay
+  prompt (original + all-but-one emitted token) must fit the prefill
+  window of whichever replica inherits it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from apex_tpu.serving.serve import Request
+
+__all__ = ["LogEntry", "RequestLog", "resume_request"]
+
+
+@dataclasses.dataclass
+class LogEntry:
+    """One request's replayable state."""
+
+    request: Request            # the ORIGINAL request, never mutated
+    slo: str
+    replica: str                # current holder
+    t_arrive: float
+    #: harvested tokens — committed prefix of the output stream
+    emitted: List[int] = dataclasses.field(default_factory=list)
+    #: tokens already moved into the prompt by past migrations; the
+    #: current holder's own progress is appended on top of these
+    replayed: List[int] = dataclasses.field(default_factory=list)
+    replays: int = 0
+    done: bool = False
+    reason: Optional[str] = None
+    #: first time any committed token was observed (harvest-boundary
+    #: accurate) — the fleet-level, arrival-anchored TTFT numerator
+    t_first: Optional[float] = None
+    t_done: Optional[float] = None
+
+
+class RequestLog:
+    """uid-keyed log of every admitted request's replayable state.
+
+    The router drives it: :meth:`admit` at submission,
+    :meth:`record_progress` after each replica harvest,
+    :meth:`complete` when a completion surfaces, :meth:`reassign` when
+    a migration moves an entry.  All host-side Python — the log's cost
+    is a dict update per harvest."""
+
+    def __init__(self):
+        self._entries: Dict[Any, LogEntry] = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, uid: Any) -> bool:
+        return uid in self._entries
+
+    def get(self, uid: Any) -> LogEntry:
+        return self._entries[uid]
+
+    def admit(self, request: Request, slo: str, replica: str,
+              t_arrive: float) -> LogEntry:
+        if request.uid in self._entries:
+            raise ValueError(
+                f"uid {request.uid!r} is already logged — fleet uids "
+                "must be unique across the run")
+        e = LogEntry(request=request, slo=slo, replica=replica,
+                     t_arrive=float(t_arrive))
+        self._entries[request.uid] = e
+        return e
+
+    def record_progress(self, replica: str,
+                        progress: Dict[Any, List[int]],
+                        now: float) -> None:
+        """Fold one replica's post-harvest ``progress()`` into the log:
+        ``emitted`` becomes the migration-committed tokens plus the
+        current holder's harvested stream."""
+        for uid, toks in progress.items():
+            e = self._entries.get(uid)
+            if e is None or e.done or e.replica != replica:
+                continue
+            e.emitted = e.replayed + list(toks)
+            if e.emitted and e.t_first is None:
+                e.t_first = now
+
+    def complete(self, uid: Any, tokens: List[int], reason: str,
+                 now: float) -> LogEntry:
+        e = self._entries[uid]
+        e.emitted = e.replayed + list(tokens)
+        if e.emitted and e.t_first is None:
+            e.t_first = now
+        e.done, e.reason, e.t_done = True, reason, now
+        return e
+
+    def reassign(self, uid: Any, replica: str) -> None:
+        """Move an entry to a new holder (a migration): the committed
+        stream becomes replayed prompt suffix for the re-admission."""
+        e = self._entries[uid]
+        e.replayed = list(e.emitted)
+        e.replica = replica
+        e.replays += 1
+
+    def inflight_on(self, replica: str) -> List[LogEntry]:
+        """Entries the named replica holds that have not completed —
+        queued and admitted alike (what a death must migrate)."""
+        return [e for e in self._entries.values()
+                if not e.done and e.replica == replica]
+
+    def pending(self) -> int:
+        return sum(1 for e in self._entries.values() if not e.done)
+
+
+def resume_request(entry: LogEntry) -> Request:
+    """The re-admission for a migrated entry: committed tokens become
+    prompt suffix, the budget shrinks by their count, uid and seed are
+    unchanged.  Absolute positions (and therefore the key-schedule
+    folds) match the original run's, so the continuation reproduces the
+    stream the dead replica would have produced."""
+    base = entry.request
+    emitted = list(entry.emitted)
+    budget = base.max_new_tokens - len(emitted)
+    if budget < 1:
+        raise ValueError(
+            f"uid {base.uid!r} has no budget left to resume "
+            f"({len(emitted)}/{base.max_new_tokens} tokens emitted) — "
+            "a spent request should have completed, not migrated")
+    return Request(uid=base.uid,
+                   prompt=list(base.prompt) + emitted,
+                   max_new_tokens=budget,
+                   seed=base.seed)

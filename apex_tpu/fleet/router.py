@@ -1,0 +1,433 @@
+"""Fleet-tier serving: one router over N continuous-batching replicas.
+
+The serving stack below this module tops out at one
+:class:`~apex_tpu.serving.serve.ContinuousBatcher` — one chip's worth
+of users, no notion of a latency class, and a single point of failure.
+This module is the scenario layer on top: N batcher replicas
+(dp-replicated ``decode_fns`` — the SAME jitted step functions drive
+every replica, each over its own cache and pools, so the fleet adds
+ZERO compilations) behind one :class:`FleetRouter` that decides, per
+request, WHO serves it and WHEN.
+
+Everything the router needs already exists as host-side mirrors — the
+design rule is **no new host syncs**:
+
+- **routing key**: the prefix cache's cumulative page hash
+  (:func:`~apex_tpu.serving.kv_cache.prompt_page_hashes`) — replica-
+  independent by construction, so the router hashes a prompt once and
+  probes every replica's prefix index read-only
+  (``PagedKVCache.match_len``).  Requests sharing a system prompt land
+  on the replica whose pages already hold it; prefill chunks the match
+  covers are never computed.
+- **load score**: free KV pages (``allocator.num_free``), queue depth,
+  live slots — the same quantities the batcher exports as the
+  ``pages_free`` / ``pages_shared`` / ``live_slots`` / ``queue_depth``
+  telemetry gauges.
+- **SLO classes**: per-class queues drained in priority order at every
+  pump (interactive ahead of batch on the SAME replica — stable sort,
+  FIFO within a class) with per-class admission control: a class whose
+  fleet-wide queue is at ``max_queue`` REJECTS instead of growing an
+  unbounded backlog (``request_rejected`` event; the caller retries or
+  sheds).
+
+Policy is ONE declarative object (:class:`FleetPolicy`), not a pile of
+flags — the veScale one-consistent-spec discipline: construct it once,
+read any routing/admission decision off it.  ``routing="round_robin"``
+is the deliberately dumb baseline (ignores affinity, load AND class
+priority) the ``_dryrun_fleet`` gate and the bench rows compare
+against.
+
+Failover rides the request log (:mod:`apex_tpu.fleet.failover`):
+killing a replica between windows — the in-process analog of the
+resilience tier's SIGKILL drills, injected via ``Replica.kill()`` /
+``Replica.fail_after(windows)`` — re-admits its queued AND in-flight
+requests on surviving replicas with committed tokens replayed as
+prompt suffix.  Zero requests are lost, and the replayed continuations
+are token-identical (greedy or seeded) to an unkilled run.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.fleet.failover import RequestLog, resume_request
+from apex_tpu.serving.kv_cache import prompt_page_hashes
+from apex_tpu.serving.serve import ContinuousBatcher, Request
+
+__all__ = ["SLOClass", "FleetPolicy", "Replica", "FleetCompletion",
+           "FleetRouter", "INTERACTIVE", "BATCH"]
+
+_ROUTINGS = ("affinity", "least_loaded", "round_robin")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOClass:
+    """One latency class.  ``priority`` orders admission (lower admits
+    first); ``max_queue`` caps the class's fleet-wide QUEUED requests —
+    beyond it, :meth:`FleetRouter.submit` rejects (admission control:
+    an interactive class would rather shed than queue past its SLO,
+    a batch class usually leaves it ``None``/unbounded)."""
+
+    name: str
+    priority: int = 0
+    max_queue: Optional[int] = None
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("SLO class needs a name")
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None)")
+
+
+INTERACTIVE = SLOClass("interactive", priority=0)
+BATCH = SLOClass("batch", priority=1)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetPolicy:
+    """The fleet's ONE declarative policy: SLO classes, routing mode,
+    load-score weights.  Every router decision reads off this object.
+
+    ``routing``: ``"affinity"`` (prefix-match first, least-loaded
+    tie-break/fallback), ``"least_loaded"`` (load only), or
+    ``"round_robin"`` (the baseline: cycles replicas and ignores class
+    priority).  The load score is
+    ``w_queue * queue_depth + w_slots * live_slots
+    - w_pages * free_page_fraction`` — smaller is less loaded."""
+
+    classes: Tuple[SLOClass, ...] = (INTERACTIVE, BATCH)
+    routing: str = "affinity"
+    w_queue: float = 1.0
+    w_slots: float = 1.0
+    w_pages: float = 1.0
+
+    def __post_init__(self):
+        if self.routing not in _ROUTINGS:
+            raise ValueError(
+                f"routing must be one of {_ROUTINGS}, "
+                f"got {self.routing!r}")
+        if not self.classes:
+            raise ValueError("policy needs at least one SLO class")
+        names = [c.name for c in self.classes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO class names: {names}")
+
+    def cls(self, name: str) -> SLOClass:
+        for c in self.classes:
+            if c.name == name:
+                return c
+        raise ValueError(
+            f"unknown SLO class {name!r} "
+            f"(policy has {[c.name for c in self.classes]})")
+
+
+class Replica:
+    """One fleet member: a named batcher plus its liveness and the
+    fault-injection seam.  ``kill()`` marks it dead immediately;
+    ``fail_after(n)`` arms a deterministic death after ``n`` harvest
+    windows — the in-process analog of the resilience tier's
+    ``tools/fault_drill.py`` SIGKILL, placed at the only boundary an
+    in-process replica has (between windows; a real preemption
+    additionally loses the unharvested window, which the replay
+    contract already treats as uncommitted)."""
+
+    def __init__(self, name: str, batcher: ContinuousBatcher):
+        self.name = str(name)
+        self.batcher = batcher
+        self.alive = True
+        self.windows = 0
+        self.fail_at: Optional[int] = None
+
+    def kill(self) -> None:
+        self.alive = False
+
+    def fail_after(self, windows: int) -> None:
+        if windows < 0:
+            raise ValueError("fail_after expects >= 0 windows")
+        self.fail_at = int(windows)
+
+
+@dataclasses.dataclass
+class FleetCompletion:
+    """A completed fleet request: the FULL stitched token stream (every
+    migration's committed tokens plus the final continuation), against
+    the ORIGINAL prompt length.  ``ttft_s``/``duration_s`` are
+    arrival-anchored (queue wait included — what an SLO sees), accurate
+    to the harvest boundary."""
+
+    uid: Any
+    tokens: List[int]
+    prompt_len: int
+    reason: str
+    slo: str
+    replica: str
+    replays: int = 0
+    ttft_s: Optional[float] = None
+    duration_s: Optional[float] = None
+
+    @property
+    def itl_ms(self) -> Optional[float]:
+        """Mean inter-token latency (ms) over the request's own stream
+        — first token to completion, arrival-clock, harvest-granular."""
+        if self.ttft_s is None or self.duration_s is None or \
+                len(self.tokens) < 2:
+            return None
+        return ((self.duration_s - self.ttft_s)
+                / (len(self.tokens) - 1) * 1e3)
+
+
+class FleetRouter:
+    """Route requests over replicas per a :class:`FleetPolicy`.
+
+    ``replicas`` are :class:`Replica` objects or bare batchers (wrapped
+    as ``r0``, ``r1``, ...).  All replicas must share one cache config
+    family — same ``page_size`` (the routing key's unit) and prompt
+    window.  ``logger`` is an optional
+    :class:`~apex_tpu.telemetry.MetricsLogger`; the router adds
+    ``request_routed`` / ``request_rejected`` / ``request_migrated`` /
+    ``replica_dead`` events on top of each batcher's own stream.
+
+    Drive it with :meth:`submit` + :meth:`step` (one harvest window on
+    every live replica per step — no replica blocks another), or
+    :meth:`drain` to run pending work to completion.  Results land in
+    ``self.completions`` (uid -> :class:`FleetCompletion`)."""
+
+    def __init__(
+        self,
+        replicas: Sequence[Any],
+        policy: Optional[FleetPolicy] = None,
+        *,
+        logger: Optional[Any] = None,
+        clock=time.perf_counter,
+    ):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas: List[Replica] = [
+            r if isinstance(r, Replica) else Replica(f"r{i}", r)
+            for i, r in enumerate(replicas)
+        ]
+        names = [r.name for r in self.replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        sizes = {r.batcher.cache.config.page_size
+                 for r in self.replicas}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"replicas disagree on page_size {sorted(sizes)} — "
+                "the routing key is per-page, all replicas must share "
+                "one cache config family")
+        self.policy = policy if policy is not None else FleetPolicy()
+        self.logger = logger
+        self._clock = clock
+        self._page_size = sizes.pop()
+        self._max_prompt_len = min(
+            r.batcher.max_prompt_len for r in self.replicas)
+        self.log = RequestLog()
+        self.completions: Dict[Any, FleetCompletion] = {}
+        self.rejected: Dict[Any, str] = {}          # uid -> reason
+        self._queues: Dict[str, collections.deque] = {
+            r.name: collections.deque() for r in self.replicas}
+        self._cls: Dict[Any, str] = {}              # uid -> class name
+        self._rr = 0
+        self.stats = {
+            "submitted": 0, "rejected": 0, "migrations": 0,
+            "affinity_routed": 0,
+            "routed": {r.name: 0 for r in self.replicas},
+        }
+
+    # ------------------------------------------------------------ events
+    def _event(self, kind: str, **fields) -> None:
+        if self.logger is not None:
+            self.logger.event(kind, **fields)
+
+    # ------------------------------------------------------------- state
+    @property
+    def pending(self) -> int:
+        """Requests submitted but not yet completed."""
+        return self.log.pending()
+
+    def queue_depth(self, cls_name: Optional[str] = None) -> int:
+        """Fleet-wide QUEUED (not yet admitted) requests, optionally
+        restricted to one SLO class."""
+        n = 0
+        for q in self._queues.values():
+            for req in q:
+                if cls_name is None or self._cls[req.uid] == cls_name:
+                    n += 1
+        return n
+
+    def _load(self, r: Replica) -> float:
+        """Host-mirror load score — the telemetry-gauge quantities,
+        read directly (no device sync, no jsonl round-trip)."""
+        p = self.policy
+        cfg = r.batcher.cache.config
+        free_frac = (r.batcher.cache.allocator.num_free
+                     / max(1, cfg.num_pages - 1))
+        return (p.w_queue * len(self._queues[r.name])
+                + p.w_slots * r.batcher.live_slots
+                - p.w_pages * free_frac)
+
+    # ------------------------------------------------------------- route
+    def _route(self, request: Request) -> Tuple[Replica, int]:
+        """Pick the serving replica; returns ``(replica,
+        affinity_tokens)``.  Deterministic: ties break on replica
+        order."""
+        alive = [r for r in self.replicas if r.alive]
+        if not alive:
+            raise RuntimeError("no replica is alive")
+        if self.policy.routing == "round_robin":
+            r = alive[self._rr % len(alive)]
+            self._rr += 1
+            return r, 0
+        key = (prompt_page_hashes(request.prompt, self._page_size)
+               if self.policy.routing == "affinity" else [])
+        best, best_score, best_aff = None, None, 0
+        for i, r in enumerate(alive):
+            aff = r.batcher.cache.match_len(key) if key else 0
+            score = (-aff, self._load(r), i)
+            if best_score is None or score < best_score:
+                best, best_score, best_aff = r, score, aff
+        return best, best_aff
+
+    # ------------------------------------------------------------ submit
+    def submit(self, request: Request, slo: Optional[str] = None,
+               *, t_arrive: Optional[float] = None) -> bool:
+        """Admission-control one request into the fleet.  Returns False
+        (and emits ``request_rejected``) when the request can never be
+        served (prompt + replay headroom past the prompt window, or
+        more pages than any replica's pool) or its class queue is full;
+        True once it is routed and logged.  ``slo`` defaults to the
+        policy's first (highest-priority) class.
+
+        The prompt-window check reserves REPLAY headroom: migration
+        re-admits ``prompt + emitted`` as a prompt, so
+        ``len(prompt) + max_new_tokens - 1`` must fit
+        ``max_prompt_len`` — enforced here, not discovered at failover
+        time."""
+        cls = self.policy.cls(slo) if slo is not None \
+            else self.policy.classes[0]
+        cfg = self.replicas[0].batcher.cache.config
+        plen = len(request.prompt)
+        total = plen + request.max_new_tokens
+        reason = None
+        if plen + request.max_new_tokens - 1 > self._max_prompt_len:
+            reason = "too_large"
+        elif (total > cfg.max_len
+                or cfg.tokens_to_pages(total) > cfg.num_pages - 1):
+            reason = "too_large"
+        elif cls.max_queue is not None and \
+                self.queue_depth(cls.name) >= cls.max_queue:
+            reason = "queue_full"
+        if reason is not None:
+            self.rejected[request.uid] = reason
+            self.stats["rejected"] += 1
+            self._event("request_rejected", uid=request.uid,
+                        slo=cls.name, reason=reason)
+            return False
+        replica, aff = self._route(request)
+        now = self._clock() if t_arrive is None else float(t_arrive)
+        self.log.admit(request, cls.name, replica.name, now)
+        self._cls[request.uid] = cls.name
+        self._queues[replica.name].append(request)
+        self.stats["submitted"] += 1
+        self.stats["routed"][replica.name] += 1
+        if aff > 0:
+            self.stats["affinity_routed"] += 1
+        self._event("request_routed", uid=request.uid,
+                    replica=replica.name, slo=cls.name, affinity=aff)
+        return True
+
+    # -------------------------------------------------------------- step
+    def _pump_order(self, name: str) -> collections.deque:
+        """The replica's admission queue for this pump: class priority
+        first (stable — FIFO within a class), unless the round-robin
+        baseline, which is FIFO across classes too."""
+        items = list(self._queues[name])
+        if self.policy.routing != "round_robin":
+            prio = {c.name: c.priority for c in self.policy.classes}
+            items.sort(key=lambda req: prio[self._cls[req.uid]])
+        return collections.deque(items)
+
+    def step(self) -> bool:
+        """One fleet scheduling turn: fire any armed fault seams,
+        migrate work off dead replicas, pump every live replica one
+        harvest window, absorb progress and completions into the log.
+        Returns True while requests remain pending."""
+        for r in self.replicas:
+            if r.alive and r.fail_at is not None \
+                    and r.windows >= r.fail_at:
+                r.kill()
+        for r in self.replicas:
+            if not r.alive and (self._queues[r.name]
+                                or self.log.inflight_on(r.name)):
+                self._migrate(r)
+        for r in self.replicas:
+            if not r.alive:
+                continue
+            work = self._pump_order(r.name)
+            if not work and r.batcher.live_slots == 0:
+                continue
+            r.batcher.pump(work)
+            r.windows += 1
+            self._queues[r.name] = work
+            self._absorb(r)
+        return self.pending > 0
+
+    def drain(self, max_steps: int = 100_000
+              ) -> Dict[Any, FleetCompletion]:
+        """Step until nothing is pending (bounded by ``max_steps`` so a
+        scheduling bug hangs a test, not a host)."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet did not drain in {max_steps} steps "
+                    f"({self.pending} requests still pending)")
+        return self.completions
+
+    # ----------------------------------------------------------- absorb
+    def _absorb(self, r: Replica) -> None:
+        now = self._clock()
+        self.log.record_progress(r.name, r.batcher.progress(), now)
+        for uid, comp in r.batcher.completions.items():
+            if uid in self.completions or uid not in self.log:
+                continue
+            e = self.log.get(uid)
+            if e.done or e.replica != r.name:
+                continue
+            e = self.log.complete(uid, comp.tokens, comp.reason, now)
+            self.completions[uid] = FleetCompletion(
+                uid=uid, tokens=list(e.emitted),
+                prompt_len=len(e.request.prompt),
+                reason=e.reason, slo=e.slo, replica=r.name,
+                replays=e.replays,
+                ttft_s=(None if e.t_first is None
+                        else e.t_first - e.t_arrive),
+                duration_s=now - e.t_arrive,
+            )
+
+    # ---------------------------------------------------------- failover
+    def _migrate(self, dead: Replica) -> None:
+        """Re-admit everything a dead replica held: queued requests
+        move as-is, in-flight ones replay their committed tokens as
+        prompt suffix (:func:`resume_request`).  Zero requests are
+        lost; uncommitted (unharvested) tokens are regenerated, not
+        recovered."""
+        entries = self.log.inflight_on(dead.name)
+        self._queues[dead.name].clear()
+        self._event("replica_dead", replica=dead.name,
+                    migrated=len(entries))
+        for e in entries:
+            req = resume_request(e)
+            target, aff = self._route(req)
+            self.log.reassign(req.uid, target.name)
+            self._queues[target.name].append(req)
+            self.stats["migrations"] += 1
+            self.stats["routed"][target.name] += 1
+            self._event("request_migrated", uid=req.uid,
+                        replica=target.name, replays=e.replays,
+                        affinity=aff)

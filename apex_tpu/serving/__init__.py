@@ -34,6 +34,7 @@ _LAZY_ATTRS = {
     "PagedKVCache": "apex_tpu.serving.kv_cache",
     "CacheOutOfPages": "apex_tpu.serving.kv_cache",
     "AdmitResult": "apex_tpu.serving.kv_cache",
+    "prompt_page_hashes": "apex_tpu.serving.kv_cache",
     "init_pools": "apex_tpu.serving.kv_cache",
     "write_tokens": "apex_tpu.serving.kv_cache",
     "copy_pages": "apex_tpu.serving.kv_cache",

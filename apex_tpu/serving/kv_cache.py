@@ -58,6 +58,7 @@ __all__ = [
     "AdmitResult",
     "PageAllocator",
     "PagedKVCache",
+    "prompt_page_hashes",
     "init_pools",
     "write_tokens",
     "write_targets",
@@ -69,6 +70,23 @@ class CacheOutOfPages(RuntimeError):
     """The pool has fewer free pages than an admission needs.  The
     serving driver treats this as backpressure (the request waits in
     the queue), not an error."""
+
+
+def prompt_page_hashes(prompt_tokens, page_size: int) -> List[bytes]:
+    """Cumulative SHA-1 of a prompt's FULL pages — the prefix-cache
+    identity (``h_i = sha1(h_{i-1} || page_i tokens)``) and, because it
+    depends only on token ids and ``page_size``, the fleet router's
+    replica-independent routing key: every replica of one cache config
+    computes the same hashes for the same prompt."""
+    import hashlib
+
+    toks = [int(t) for t in prompt_tokens]
+    hashes, h = [], hashlib.sha1()
+    for i in range(len(toks) // page_size):
+        h.update(np.asarray(toks[i * page_size: (i + 1) * page_size],
+                            np.int64).tobytes())
+        hashes.append(h.digest())
+    return hashes
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,6 +167,12 @@ class PageAllocator:
     @property
     def num_free(self) -> int:
         return len(self._free)
+
+    @property
+    def num_shared(self) -> int:
+        """Pages with more than one holder — live prefix sharing (the
+        ``pages_shared`` telemetry gauge; pure host state)."""
+        return sum(1 for c in self._refcount.values() if c > 1)
 
     def refcount(self, page: int) -> int:
         """Current holders of ``page`` (0 = free)."""
@@ -266,20 +290,25 @@ class PagedKVCache:
         covers tokens ``[0, (i+1) * page_size)`` — a page's identity is
         its whole history, so two pages hash equal iff every token
         before and inside them matches)."""
-        import hashlib
-
-        ps = self.config.page_size
-        toks = [int(t) for t in prompt_tokens]
-        hashes, h = [], hashlib.sha1()
-        for i in range(len(toks) // ps):
-            h.update(np.asarray(toks[i * ps: (i + 1) * ps],
-                                np.int64).tobytes())
-            hashes.append(h.digest())
-        return hashes
+        return prompt_page_hashes(prompt_tokens, self.config.page_size)
 
     @property
     def prefix_index_size(self) -> int:
         return len(self._prefix)
+
+    def match_len(self, hashes: List[bytes]) -> int:
+        """Tokens of a prompt already resident in this cache's prefix
+        index: the longest run of leading ``hashes``
+        (:func:`prompt_page_hashes`) the index holds, in tokens.  A
+        read-only probe — no allocation, no refcounts, no device sync —
+        the fleet router's prefix-affinity score
+        (:mod:`apex_tpu.fleet.router`)."""
+        n = 0
+        for h in hashes:
+            if h not in self._prefix:
+                break
+            n += 1
+        return n * self.config.page_size
 
     def _evict_prefix(self, n: int) -> int:
         """Refcount GC: unregister up to ``n`` index entries whose page

@@ -290,6 +290,39 @@ class ContinuousBatcher:
         if self.logger is not None:
             self.logger.event(kind, **fields)
 
+    def _emit_gauges(self, queue_depth: int) -> None:
+        """The serving load gauges (``pages_free`` / ``pages_shared`` /
+        ``live_slots`` / ``queue_depth``): pure host mirrors, no device
+        sync — the same signals the fleet router scores replicas by,
+        exported so a single-replica operator sees them too."""
+        if self.logger is None:
+            return
+        self.logger.gauge("pages_free", self.cache.allocator.num_free)
+        self.logger.gauge("pages_shared",
+                          self.cache.allocator.num_shared)
+        self.logger.gauge("live_slots", self.live_slots)
+        self.logger.gauge("queue_depth", int(queue_depth))
+
+    # ------------------------------------------------------ host mirrors
+    @property
+    def live_slots(self) -> int:
+        """Slots currently decoding or prefilling — host state only."""
+        return len(self._meta) + len(self._prefilling)
+
+    def progress(self) -> Dict[Any, List[int]]:
+        """Harvested tokens so far for every in-flight request (uid ->
+        committed tokens; a still-prefilling request maps to ``[]``).
+        Harvest is the commit point: tokens a later window would
+        surface are NOT included — exactly the replayable state the
+        fleet failover log records."""
+        out: Dict[Any, List[int]] = {
+            m["req"].uid: list(m["tokens"])
+            for m in self._meta.values()
+        }
+        for st in self._prefilling.values():
+            out[st["req"].uid] = []
+        return out
+
     def _note_stall(self, dur_s: float) -> None:
         """Account prefill work that ran while decode slots were live
         — the stall the chunk budget exists to bound."""
@@ -382,6 +415,7 @@ class ContinuousBatcher:
             self._slot_live(slot, first, req, plen, t_admit, skey)
             self._event("span", span="prefill", slot=slot,
                         tokens=plen, dispatch_s=round(dispatch_s, 6))
+        self._emit_gauges(len(queue))
 
     def _admit_chunked(self, slot, req, res, skey, t_admit,
                        page_row) -> None:
@@ -589,6 +623,63 @@ class ContinuousBatcher:
                         ttft_s=(None if comp.ttft_s is None
                                 else round(comp.ttft_s, 6)),
                         duration_s=round(comp.duration_s, 6))
+
+    # ------------------------------------------------------------ cancel
+    def cancel(self, uid: Any) -> Optional[List[int]]:
+        """Evict an in-flight request: release its slot, drop its page
+        refcounts (shared prefix pages other holders keep stay
+        allocated), freeze the slot on device, and emit a
+        ``request_cancelled`` event.  Returns the tokens harvested so
+        far (``[]`` for a still-prefilling request), or ``None`` when
+        ``uid`` is not in flight — no :class:`Completion` is recorded,
+        so the uid can be re-served later (the fleet migration path
+        replays exactly these tokens as a prompt suffix).
+
+        An unharvested window may already have produced more tokens on
+        device; they are dropped — harvest is the commit point, and a
+        seeded (or greedy) request regenerates them identically."""
+        for slot, m in self._meta.items():
+            if m["req"].uid != uid:
+                continue
+            self._first_tok.pop(slot, None)
+            tokens = list(m["tokens"])
+            del self._meta[slot]
+            self.cache.retire(slot)
+            c = self.carry
+            self.carry = {**c, "done": c["done"].at[slot].set(True)}
+            self._event("request_cancelled", uid=uid, slot=slot,
+                        new_tokens=len(tokens))
+            return tokens
+        for slot, st in self._prefilling.items():
+            if st["req"].uid != uid:
+                continue
+            del self._prefilling[slot]
+            self.cache.retire(slot)
+            self._event("request_cancelled", uid=uid, slot=slot,
+                        new_tokens=0)
+            return []
+        return None
+
+    # -------------------------------------------------------------- pump
+    def pump(self, queue) -> bool:
+        """ONE scheduler turn over an external queue: admit while slots
+        and pages allow, then run one harvest window.  Returns True
+        while the batcher still holds or awaits work — the fleet
+        router's unit of interleaving (it pumps every replica once per
+        fleet step, so no replica's window blocks another's
+        admissions).  ``queue`` is a ``collections.deque`` of
+        :class:`Request`; admitted entries are popped, backpressured
+        ones stay."""
+        self._admit(queue)
+        if not self._meta and not self._prefilling:
+            if queue:
+                raise CacheOutOfPages(
+                    "no slot can ever admit the next request "
+                    f"(prompt+budget needs more pages than the "
+                    f"pool holds: {queue[0].uid!r})")
+            return False
+        self._decode_window()
+        return bool(self._meta or self._prefilling or queue)
 
     # --------------------------------------------------------------- run
     def run(self, requests: Sequence[Request]) -> Dict[Any, Completion]:

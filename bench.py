@@ -1233,6 +1233,149 @@ def child_decode():
     }))
 
 
+def child_fleet():
+    """Fleet-tier rows: two continuous-batching replicas behind one
+    :class:`~apex_tpu.fleet.FleetRouter`, replaying the deterministic
+    bursty shared-prefix trace (``tools/load_gen.py``) under
+    prefix-affinity + SLO-priority scheduling vs the round-robin
+    baseline, plus the replica-kill drill's ledger.  The headline is
+    the interactive p99 TTFT speedup (rr / affinity) on pools sized so
+    round-robin thrashes the prefix index — same engineered shape as
+    the ``_dryrun_fleet`` gate, but the bench row RECORDS rather than
+    asserts.  Always a CPU measurement, so per the PR 3 convention
+    ``vs_baseline`` is null."""
+    _pin_cpu()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.fleet import FleetPolicy, FleetRouter, Replica
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.serving.kv_cache import (
+        KVCacheConfig, PagedKVCache, init_pools,
+    )
+    from apex_tpu.serving.serve import ContinuousBatcher, Request
+    from apex_tpu.transformer import parallel_state
+    from tools.load_gen import make_trace, replay, summarize_trace
+
+    VOCAB, LAYERS, HIDDEN, HEADS = 256, 2, 64, 4
+    PAGE, CHUNK, MAXP, PAGES, REPLICAS = 4, 8, 96, 49, 2
+    mesh = parallel_state.initialize_model_parallel(
+        devices=jax.devices()[:1])
+    model = GPTModel(GPTConfig(
+        vocab_size=VOCAB, num_layers=LAYERS, hidden_size=HIDDEN,
+        num_attention_heads=HEADS, max_position_embeddings=128,
+        compute_dtype=jnp.float32, attention_impl="xla", remat=False,
+    ))
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = KVCacheConfig(
+        num_layers=LAYERS, num_heads=HEADS, head_dim=HIDDEN // HEADS,
+        num_pages=PAGES, page_size=PAGE, max_seqs=2,
+        pages_per_seq=-(-MAXP // PAGE), dtype=jnp.float32)
+    fns = model.decode_fns(params, mesh, cfg, max_prompt_len=MAXP,
+                           prefill_chunk=CHUNK)
+
+    def replicas():
+        return [
+            Replica(f"r{i}", ContinuousBatcher(
+                fns.prefill, fns.decode, PagedKVCache(cfg),
+                init_pools(cfg), max_prompt_len=MAXP, harvest_every=2,
+                chunk_fn=fns.chunk, prefill_chunk=CHUNK,
+                prefix_cache=True))
+            for i in range(REPLICAS)
+        ]
+
+    # warm every jit outside the measured traces (budget >= 3 covers
+    # both decode carry signatures — see _dryrun_fleet)
+    rng = np.random.RandomState(15)
+    warm = ContinuousBatcher(
+        fns.prefill, fns.decode, PagedKVCache(cfg), init_pools(cfg),
+        max_prompt_len=MAXP, harvest_every=2, chunk_fn=fns.chunk,
+        prefill_chunk=CHUNK, prefix_cache=True)
+    warm.run([Request(
+        uid="warm", max_new_tokens=4, seed=1,
+        prompt=[int(t) for t in rng.randint(1, VOCAB, (88,))])])
+
+    traces = [
+        make_trace(n_requests=64, seed=sd, vocab_size=VOCAB,
+                   mean_gap=0.5, burstiness=6.0, prompt_len=(68, 88),
+                   new_tokens=(4, 8), interactive_frac=0.5, cohorts=4,
+                   cohort_frac=0.9, prefix_len=64)
+        for sd in (11, 6)
+    ]
+    rows = {}
+    ttfts = {}
+    for routing in ("affinity", "least_loaded", "round_robin"):
+        pooled = []
+        chunks = hits = 0
+        t0 = time.perf_counter()
+        for trace in traces:
+            router = FleetRouter(replicas(),
+                                 FleetPolicy(routing=routing))
+            recs = replay(router, trace)
+            pooled += [r["ttft_s"] for r in recs
+                       if r.get("slo") == "interactive"
+                       and isinstance(r.get("ttft_s"), (int, float))]
+            chunks += sum(r.batcher.prefill_chunks
+                          for r in router.replicas)
+            hits += sum(r.batcher.prefix_stats["hits"]
+                        for r in router.replicas)
+        wall = time.perf_counter() - t0
+        pooled.sort()
+        pct = lambda q: pooled[min(len(pooled) - 1,
+                                   int(round(q * (len(pooled) - 1))))]
+        ttfts[routing] = pct(0.99)
+        rows[routing] = {
+            "interactive_ttft_p50_ms": round(pct(0.50) * 1e3, 2),
+            "interactive_ttft_p99_ms": round(pct(0.99) * 1e3, 2),
+            "prefill_chunks": chunks,
+            "prefix_hits": hits,
+            "wall_ms": round(wall * 1e3, 1),
+        }
+        log(f"fleet {routing}: i-p99 "
+            f"{rows[routing]['interactive_ttft_p99_ms']} ms, "
+            f"{chunks} chunks, {hits} prefix hits")
+
+    # replica-kill drill: r0 dies mid-trace, nothing may be lost
+    drill = FleetRouter(replicas(), FleetPolicy())
+    drill.replicas[0].fail_after(6)
+    dsum = summarize_trace(replay(drill, traces[0]))
+    ref = FleetRouter(replicas(), FleetPolicy())
+    replay(ref, traces[0])
+    identical = all(
+        drill.completions[u].tokens == c.tokens
+        for u, c in ref.completions.items())
+    rows["kill_drill"] = {
+        "migrated": drill.stats["migrations"],
+        "lost": dsum["lost"],
+        "completed": dsum["completed"],
+        "token_identical_to_unkilled": identical,
+    }
+    log(f"fleet drill: {rows['kill_drill']}")
+
+    speedup = ttfts["round_robin"] / ttfts["affinity"]
+    print(json.dumps({
+        "metric": "fleet_interactive_p99_ttft_speedup",
+        "value": round(speedup, 2),
+        "unit": "x (round_robin / affinity+SLO, 2 replicas, "
+                "2 pooled 64-request traces)",
+        # no TPU measurement happened here: null, not a fake ratio
+        # (PR 3 convention)
+        "vs_baseline": None,
+        "platform": "cpu-virtual",
+        "note": "scheduling-quality row — pools sized so round-robin "
+                "thrashes the prefix index (4 cohorts, ~2 fit); "
+                "records the routing win and the zero-loss drill, "
+                "asserted by the _dryrun_fleet gate",
+        "rows": rows,
+        "spec": {"vocab": VOCAB, "layers": LAYERS, "hidden": HIDDEN,
+                 "heads": HEADS, "page_size": PAGE,
+                 "prefill_chunk": CHUNK, "num_pages": PAGES,
+                 "replicas": REPLICAS, "max_prompt_len": MAXP,
+                 "trace_seeds": [11, 6], "requests_per_trace": 64},
+    }))
+
+
 def child_telemetry():
     """Telemetry-overhead row: ms/step of the flagship CPU-dryrun-shape
     GPT step (the same reduced config child_gpt's CPU fallback
@@ -1571,6 +1714,28 @@ def _t5_extra(out, on_tpu):
 
 
 # ---------------------------------------------------------------- orchestrator
+def _merge_bench_extra(path, extras):
+    """Merge this run's extras into BENCH_EXTRA.json instead of
+    clobbering it: a budget-starved run that only produced (say) the
+    fleet row must not erase the grad-sync/zero3/decode rows a fuller
+    earlier capture wrote.  This run's keys win on collision (they are
+    fresher measurements of the same thing); unknown or unreadable
+    existing content is replaced, not merged."""
+    merged = dict(extras)
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+        if isinstance(prior, dict):
+            merged = {**prior, **extras}
+    except (OSError, ValueError):
+        pass
+    try:
+        with open(path, "w") as f:
+            json.dump(merged, f, indent=1)
+    except OSError as e:
+        log(f"extras write failed: {e}")
+
+
 def _run_child(args, timeout):
     """Run `python bench.py <args>` bounded; return (ok, last_json, tail).
 
@@ -1924,15 +2089,28 @@ def main():
     else:
         log(f"skipping decode row: {budget_left():.0f}s budget left")
 
+    # fleet-tier row (multi-replica routing + failover drill over the
+    # serving stack) — rides BENCH_EXTRA.json, never the headline
+    if budget_left() > 150:
+        ok, fl, err = _run_child(
+            ["--child", "fleet", "--platform", "cpu"],
+            min(budget_left(), 600),
+        )
+        if ok:
+            extras = extras if extras is not None else {
+                "platform": "cpu-virtual"}
+            extras["fleet"] = fl
+            log(f"fleet: {fl}")
+        else:
+            log(f"fleet row failed (non-fatal): {err[-300:]}")
+    else:
+        log(f"skipping fleet row: {budget_left():.0f}s budget left")
+
     if extras is not None:
-        try:
-            with open(os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                "BENCH_EXTRA.json",
-            ), "w") as f:
-                json.dump(extras, f, indent=1)
-        except OSError as e:
-            log(f"extras write failed: {e}")
+        _merge_bench_extra(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_EXTRA.json"),
+            extras)
 
     if on_tpu:
         # only real-TPU extras may become "last TPU" hardware
@@ -1979,6 +2157,8 @@ if __name__ == "__main__":
             child_telemetry()
         elif kind == "decode":
             child_decode()
+        elif kind == "fleet":
+            child_fleet()
         else:
             raise SystemExit(f"unknown child {kind}")
     else:

@@ -19,7 +19,10 @@ The load-bearing claims, each pinned here:
   slot churn, a prefix-cache hit's logits are BIT-identical to a cold
   admission, chunk counts / hit patterns add zero jit entries, and a
   seeded request's sampled stream is reproducible regardless of
-  admission order or slot assignment.
+  admission order or slot assignment;
+- cancel(uid) frees the slot without recording a Completion (the uid
+  re-serves from scratch) and the load gauges the fleet router scores
+  by reach the metrics jsonl.
 """
 
 import json
@@ -637,6 +640,87 @@ class TestContinuousBatching:
         text = mr.format_report(summary)
         assert "serving summary" in text
         assert "time-to-first-token" in text
+
+    def test_cancel_releases_slot_and_uid_is_reservable(
+            self, gpt_setup, tmp_path):
+        """cancel(uid) mid-flight: returns the HARVESTED prefix of the
+        stream (a prefix of the reference — harvest is the commit
+        point), frees the slot for new admissions, records no
+        Completion (the uid can be re-served from scratch), and emits
+        a ``request_cancelled`` event."""
+        import collections
+
+        from apex_tpu.telemetry.metrics import MetricsLogger
+
+        mesh, model, params, prompts, plens, new, ref = gpt_setup
+        jsonl = str(tmp_path / "cancel.jsonl")
+        logger = MetricsLogger(jsonl_path=jsonl, console=False)
+        page = 4
+        pps = -(-(10 + new) // page)
+        ccfg = KVCacheConfig(
+            num_layers=2, num_heads=4, head_dim=8,
+            num_pages=1 + 2 * pps, page_size=page, max_seqs=2,
+            pages_per_seq=pps, dtype=jnp.float32)
+        fns = model.decode_fns(params, mesh, ccfg, max_prompt_len=10)
+        b = ContinuousBatcher(
+            fns.prefill, fns.decode, PagedKVCache(ccfg),
+            init_pools(ccfg), max_prompt_len=10, harvest_every=2,
+            logger=logger)
+        reqs = [
+            Request(uid=i,
+                    prompt=[int(t) for t in prompts[i, : plens[i]]],
+                    max_new_tokens=new)
+            for i in range(2)
+        ]
+        q = collections.deque(reqs)
+        b.pump(q)                       # admit both, one harvest window
+        assert b.live_slots == 2
+        free_before = b.cache.allocator.num_free
+        got = b.cancel(0)
+        want0 = list(map(int, ref[0]))
+        assert got and got == want0[: len(got)]
+        assert b.cancel("never-admitted") is None
+        assert b.live_slots == 1
+        assert b.cache.allocator.num_free > free_before
+        assert 0 not in b.completions   # cancelled, not completed
+        # the uid is free again: re-serve it from scratch to the full
+        # reference while request 1 keeps decoding undisturbed
+        q2 = collections.deque([reqs[0]])
+        while b.pump(q2):
+            pass
+        assert b.completions[0].tokens == want0
+        assert b.completions[1].tokens == list(map(int, ref[1]))
+        logger.close()
+
+        import tools.metrics_report as mr
+
+        cancels = [r for r in mr.load_records(jsonl)
+                   if r.get("event") == "request_cancelled"]
+        assert len(cancels) == 1
+        assert cancels[0]["uid"] == 0
+        assert cancels[0]["new_tokens"] == len(got)
+
+    def test_load_gauges_reach_metrics_jsonl(self, gpt_setup,
+                                             tmp_path):
+        """The serving load gauges (pages_free / pages_shared /
+        live_slots / queue_depth) — the quantities the fleet router
+        scores replicas by — land in the jsonl meters stream and the
+        report summary."""
+        from apex_tpu.telemetry.metrics import MetricsLogger
+
+        jsonl = str(tmp_path / "gauges.jsonl")
+        logger = MetricsLogger(jsonl_path=jsonl, console=False)
+        _serve(gpt_setup, n_req=3, max_seqs=2, harvest_every=4,
+               logger=logger)
+        logger.close()
+
+        import tools.metrics_report as mr
+
+        summary = mr.summarize(mr.load_records(jsonl))
+        gauges = summary["meters"]["gauges"]
+        assert {"pages_free", "pages_shared", "live_slots",
+                "queue_depth"} <= set(gauges)
+        assert all(v >= 0 for v in gauges.values())
 
     def test_request_validation(self):
         from apex_tpu.serving.serve import Request

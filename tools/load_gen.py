@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""load_gen: deterministic trace-replay load generator for the fleet.
+
+A router's p99 is made by its WORST moments — bursts landing on a busy
+replica, a batch job queued ahead of an interactive one, a cohort's
+shared prefix scattered where no cache holds it.  This module
+manufactures exactly those moments, reproducibly:
+
+- **bursty Poisson-ish arrivals** from one seeded stream: a two-state
+  modulated process (burst / lull) whose exponential gaps shrink by
+  ``burstiness`` inside a burst and stretch by it between bursts —
+  mean rate is ``1/mean_gap`` either way, but arrivals CLUMP;
+- **ragged lengths**: per-request prompt and output lengths drawn
+  uniformly from ranges, so slots churn raggedly instead of in
+  lockstep;
+- **mixed SLO classes**: each request is interactive with probability
+  ``interactive_frac``, else batch;
+- **shared-prefix cohorts**: ``cohort_frac`` of requests open with one
+  of ``cohorts`` fixed system-prompt prefixes (the prefix-affinity
+  router's whole reason to exist), the rest are cold one-offs.
+
+Every request carries a derived ``seed``, so a trace replayed through
+any fleet shape produces identical token streams (the cross-replica
+determinism contract) — which is what lets the replica-kill drill
+compare a killed run against an unkilled reference token-for-token.
+
+:func:`replay` drives a :class:`~apex_tpu.fleet.router.FleetRouter`
+through a trace in LOGICAL time — arrivals release in trace order as
+fleet steps advance (``arrivals_per_step`` trace-time units per step),
+so scheduling decisions are deterministic while TTFT/ITL are measured
+in real wall seconds (queue wait included: arrival-anchored, the
+number an SLO sees).  Per-request records go to
+:func:`summarize_trace` for p50/p95/p99 per class, and (when the
+router has a logger) each lands as a ``trace_request`` event
+``tools/metrics_report.py`` scores in its fleet section.
+
+Standalone (prints the trace's shape, no model needed)::
+
+    python tools/load_gen.py --requests 64 --seed 7
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+__all__ = ["TraceItem", "make_trace", "replay", "summarize_trace"]
+
+
+@dataclasses.dataclass
+class TraceItem:
+    """One arrival: ``t`` is abstract trace time (logical units)."""
+
+    t: float
+    request: Any                # apex_tpu.serving.serve.Request
+    slo: str
+    cohort: Optional[int]       # None = cold one-off prompt
+
+
+def make_trace(
+    *,
+    n_requests: int,
+    seed: int,
+    vocab_size: int,
+    mean_gap: float = 1.0,
+    burstiness: float = 4.0,
+    prompt_len: Tuple[int, int] = (8, 48),
+    new_tokens: Tuple[int, int] = (4, 16),
+    interactive_frac: float = 0.7,
+    cohorts: int = 4,
+    cohort_frac: float = 0.8,
+    prefix_len: int = 24,
+    burst_len: float = 8.0,
+) -> List[TraceItem]:
+    """Build a deterministic trace (same args + seed -> byte-identical
+    requests and arrival times).  Token ids are drawn from
+    ``[1, vocab_size)`` — id 0 is left out so traces compose with
+    servers that pad with 0.  ``prompt_len`` bounds INCLUDE the cohort
+    prefix; ``prefix_len`` must leave room for at least one suffix
+    token below the upper bound."""
+    if n_requests < 1:
+        raise ValueError("n_requests must be >= 1")
+    if not (0.0 <= cohort_frac <= 1.0 and
+            0.0 <= interactive_frac <= 1.0):
+        raise ValueError("fractions must be in [0, 1]")
+    if cohorts > 0 and prefix_len >= prompt_len[1]:
+        raise ValueError(
+            f"prefix_len {prefix_len} leaves no room for a suffix "
+            f"below the prompt_len bound {prompt_len[1]}")
+    if burstiness < 1.0:
+        raise ValueError("burstiness must be >= 1 (1 = plain Poisson)")
+    rng = np.random.RandomState(seed)
+    prefixes = [
+        [int(t) for t in rng.randint(1, vocab_size, (prefix_len,))]
+        for _ in range(cohorts)
+    ]
+    items: List[TraceItem] = []
+    t, in_burst, phase_left = 0.0, True, burst_len
+    for i in range(n_requests):
+        # two-state modulated arrivals: tight gaps inside a burst,
+        # stretched gaps in the lull, same 1/mean_gap long-run rate
+        scale = (mean_gap / burstiness if in_burst
+                 else mean_gap * burstiness)
+        gap = float(rng.exponential(scale))
+        t += gap
+        phase_left -= 1.0
+        if phase_left <= 0:
+            in_burst = not in_burst
+            phase_left = float(rng.exponential(burst_len)) + 1.0
+        cohort: Optional[int] = None
+        if cohorts > 0 and rng.rand() < cohort_frac:
+            cohort = int(rng.randint(cohorts))
+            lo = max(prompt_len[0], prefix_len + 1)
+            plen = int(rng.randint(lo, prompt_len[1] + 1))
+            prompt = prefixes[cohort] + [
+                int(x) for x in
+                rng.randint(1, vocab_size, (plen - prefix_len,))]
+        else:
+            plen = int(rng.randint(prompt_len[0], prompt_len[1] + 1))
+            prompt = [int(x) for x in
+                      rng.randint(1, vocab_size, (plen,))]
+        slo = ("interactive" if rng.rand() < interactive_frac
+               else "batch")
+        from apex_tpu.serving.serve import Request
+
+        items.append(TraceItem(
+            t=t,
+            request=Request(
+                uid=f"t{i:04d}", prompt=prompt,
+                max_new_tokens=int(rng.randint(new_tokens[0],
+                                               new_tokens[1] + 1)),
+                seed=int(rng.randint(1, 2**31 - 1))),
+            slo=slo, cohort=cohort))
+    return items
+
+
+def replay(
+    router,
+    trace: List[TraceItem],
+    *,
+    arrivals_per_step: float = 1.0,
+    max_steps: int = 100_000,
+) -> List[Dict[str, Any]]:
+    """Replay ``trace`` through a fleet router in logical time: each
+    :meth:`FleetRouter.step` advances the trace clock by
+    ``arrivals_per_step`` units and releases every arrival that is
+    due — deterministic scheduling, wall-clock latency measurement.
+    Returns one record per request (rejections included) and, when the
+    router has a logger, emits a ``trace_request`` event per record."""
+    sim, i, steps = 0.0, 0, 0
+    n = len(trace)
+    while i < n or router.pending > 0:
+        while i < n and trace[i].t <= sim:
+            it = trace[i]
+            router.submit(it.request, it.slo)
+            i += 1
+        if i < n and router.pending == 0:
+            # idle lull: jump to the next arrival instead of spinning
+            # empty steps (the jump lands the arrival, so no livelock)
+            sim = max(sim, trace[i].t)
+            continue
+        router.step()
+        steps += 1
+        sim += arrivals_per_step
+        if steps >= max_steps:
+            raise RuntimeError(
+                f"trace did not drain in {max_steps} fleet steps "
+                f"({router.pending} pending)")
+    records: List[Dict[str, Any]] = []
+    by_uid = {it.request.uid: it for it in trace}
+    for uid, it in by_uid.items():
+        if uid in router.rejected:
+            rec = {"uid": uid, "slo": it.slo, "cohort": it.cohort,
+                   "rejected": router.rejected[uid]}
+        elif uid in router.completions:
+            c = router.completions[uid]
+            rec = {
+                "uid": uid, "slo": c.slo, "cohort": it.cohort,
+                "replica": c.replica, "replays": c.replays,
+                "new_tokens": len(c.tokens), "reason": c.reason,
+                "ttft_s": (None if c.ttft_s is None
+                           else round(c.ttft_s, 6)),
+                "itl_ms": (None if c.itl_ms is None
+                           else round(c.itl_ms, 3)),
+            }
+        else:            # unreachable when drain finished
+            rec = {"uid": uid, "slo": it.slo, "cohort": it.cohort,
+                   "lost": True}
+        records.append(rec)
+        if router.logger is not None:
+            router.logger.event("trace_request", **rec)
+    return records
+
+
+def _pct(xs: List[float], q: float) -> float:
+    s = sorted(xs)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+def summarize_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Score a replay: per-class and overall TTFT/ITL percentiles,
+    plus the loss/rejection/migration ledger the zero-loss drill
+    asserts over."""
+    out: Dict[str, Any] = {
+        "requests": len(records),
+        "rejected": sum(1 for r in records if "rejected" in r),
+        "lost": sum(1 for r in records if r.get("lost")),
+        "migrated": sum(1 for r in records
+                        if r.get("replays", 0) > 0),
+    }
+    done = [r for r in records if "reason" in r]
+    out["completed"] = len(done)
+
+    def score(rs: List[Dict[str, Any]]) -> Dict[str, Any]:
+        ttfts = [r["ttft_s"] for r in rs
+                 if isinstance(r.get("ttft_s"), (int, float))]
+        itls = [r["itl_ms"] for r in rs
+                if isinstance(r.get("itl_ms"), (int, float))]
+        s: Dict[str, Any] = {"n": len(rs)}
+        if ttfts:
+            s["ttft_s"] = {
+                "p50": round(_pct(ttfts, 50), 6),
+                "p95": round(_pct(ttfts, 95), 6),
+                "p99": round(_pct(ttfts, 99), 6),
+                "mean": round(sum(ttfts) / len(ttfts), 6),
+            }
+        if itls:
+            s["itl_ms"] = {"p50": round(_pct(itls, 50), 3),
+                           "p99": round(_pct(itls, 99), 3)}
+        return s
+
+    out["overall"] = score(done)
+    out["by_class"] = {
+        name: score([r for r in done if r.get("slo") == name])
+        for name in sorted({r.get("slo") for r in done} - {None})
+    }
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--cohorts", type=int, default=4)
+    ap.add_argument("--burstiness", type=float, default=4.0)
+    args = ap.parse_args(argv)
+    trace = make_trace(n_requests=args.requests, seed=args.seed,
+                       vocab_size=args.vocab, cohorts=args.cohorts,
+                       burstiness=args.burstiness)
+    gaps = [b.t - a.t for a, b in zip(trace, trace[1:])]
+    by_slo: Dict[str, int] = {}
+    by_cohort: Dict[str, int] = {}
+    for it in trace:
+        by_slo[it.slo] = by_slo.get(it.slo, 0) + 1
+        key = "cold" if it.cohort is None else f"c{it.cohort}"
+        by_cohort[key] = by_cohort.get(key, 0) + 1
+    print(json.dumps({
+        "requests": len(trace),
+        "span_units": round(trace[-1].t, 3),
+        "gap_mean": round(float(np.mean(gaps)), 4) if gaps else None,
+        "gap_max": round(float(np.max(gaps)), 4) if gaps else None,
+        "by_slo": by_slo, "by_cohort": by_cohort,
+        "prompt_lens": sorted({len(it.request.prompt)
+                               for it in trace})[:8],
+    }, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -172,13 +172,103 @@ def summarize_serving(records: List[dict]) -> Optional[Dict[str, Any]]:
             if isinstance(r.get("ttft_s"), (int, float)):
                 ttfts.append(float(r["ttft_s"]))
         out["requests"] = {"completed": len(done), "by_reason": reasons}
-        if ttfts:
+        # exact TTFT: the span from each request_admitted event to the
+        # prefill span that sampled its first token, both wall-clock
+        # event timestamps — NOT the harvest-quantized ttft_s the
+        # Completion carries (the first token exists on device when the
+        # prefill span lands; the harvest merely SURFACES it later).
+        # Correlation is by slot: an admission owns its slot until its
+        # prefill completes, so the next prefill span on that slot is
+        # its own.
+        exact = _exact_ttfts(records)
+        source = "exact" if exact else "completion"
+        if not exact:
+            exact = ttfts          # old streams without admit events
+        if exact:
             out["ttft_s"] = {
-                "p50": round(_percentile(ttfts, 50), 6),
-                "p95": round(_percentile(ttfts, 95), 6),
-                "mean": round(sum(ttfts) / len(ttfts), 6),
-                "max": round(max(ttfts), 6),
+                "p50": round(_percentile(exact, 50), 6),
+                "p95": round(_percentile(exact, 95), 6),
+                "mean": round(sum(exact) / len(exact), 6),
+                "max": round(max(exact), 6),
+                "source": source,
             }
+    return out
+
+
+def _exact_ttfts(records: List[dict]) -> List[float]:
+    """Admission-to-first-token spans from exact event timestamps:
+    walk the stream in order, pairing each ``request_admitted`` with
+    the next ``span=prefill`` event on the same slot."""
+    pending: Dict[Any, float] = {}          # slot -> admit t
+    exact: List[float] = []
+    for r in records:
+        if r.get("kind") != "event" or "t" not in r:
+            continue
+        if r.get("event") == "request_admitted" and "slot" in r:
+            pending[r["slot"]] = float(r["t"])
+        elif (r.get("event") == "span" and r.get("span") == "prefill"
+                and r.get("slot") in pending):
+            exact.append(float(r["t"]) - pending.pop(r["slot"]))
+    return exact
+
+
+def summarize_fleet(records: List[dict]) -> Optional[Dict[str, Any]]:
+    """The fleet section: per-class TTFT/ITL percentiles from the
+    ``trace_request`` records ``tools/load_gen.py``'s replay emits
+    (arrival-anchored — queue wait included), plus the routing /
+    rejection / migration ledger from the router's own events.  None
+    when the stream holds no fleet records."""
+    trace = [r for r in records
+             if r.get("kind") == "event"
+             and r.get("event") == "trace_request"]
+    routed = [r for r in records
+              if r.get("kind") == "event"
+              and r.get("event") == "request_routed"]
+    if not (trace or routed):
+        return None
+    out: Dict[str, Any] = {}
+    if routed:
+        per: Dict[str, int] = {}
+        for r in routed:
+            name = str(r.get("replica", "?"))
+            per[name] = per.get(name, 0) + 1
+        out["routed"] = per
+        out["affinity_routed"] = sum(
+            1 for r in routed if r.get("affinity", 0))
+    for kind, key in (("request_rejected", "rejected"),
+                      ("request_migrated", "migrated"),
+                      ("replica_dead", "replicas_dead")):
+        n = sum(1 for r in records if r.get("kind") == "event"
+                and r.get("event") == kind)
+        if n:
+            out[key] = n
+    if trace:
+        done = [r for r in trace if "reason" in r]
+        out["trace"] = {
+            "requests": len(trace),
+            "completed": len(done),
+            "lost": sum(1 for r in trace if r.get("lost")),
+        }
+        by_class: Dict[str, Any] = {}
+        for name in sorted({str(r.get("slo")) for r in done}):
+            rs = [r for r in done if str(r.get("slo")) == name]
+            ttfts = [float(r["ttft_s"]) for r in rs
+                     if isinstance(r.get("ttft_s"), (int, float))]
+            itls = [float(r["itl_ms"]) for r in rs
+                    if isinstance(r.get("itl_ms"), (int, float))]
+            c: Dict[str, Any] = {"n": len(rs)}
+            if ttfts:
+                c["ttft_s"] = {
+                    "p50": round(_percentile(ttfts, 50), 6),
+                    "p99": round(_percentile(ttfts, 99), 6),
+                }
+            if itls:
+                c["itl_ms"] = {
+                    "p50": round(_percentile(itls, 50), 3),
+                    "p99": round(_percentile(itls, 99), 3),
+                }
+            by_class[name] = c
+        out["by_class"] = by_class
     return out
 
 
@@ -279,7 +369,10 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
                       "span", "steps", "slots", "tokens", "dur_s",
                       "uid", "slot", "reason", "new_tokens",
                       "ttft_s", "chunk", "start", "matched_tokens",
-                      "shared_pages", "tokens_skipped", "copied"):
+                      "shared_pages", "tokens_skipped", "copied",
+                      # fleet router / failover / trace fields
+                      "replica", "slo", "affinity", "replays",
+                      "migrated", "itl_ms", "rejected", "lost"):
                 if k in r:
                     entry[k] = r[k]
             timeline.append(entry)
@@ -288,6 +381,10 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
     serving = summarize_serving(records)
     if serving:
         out["serving"] = serving
+
+    fleet = summarize_fleet(records)
+    if fleet:
+        out["fleet"] = fleet
 
     return out
 
@@ -369,18 +466,25 @@ def format_report(summary: Dict[str, Any]) -> str:
                 f"p99 {i['p99']} ms")
         if "ttft_s" in sv:
             t = sv["ttft_s"]
-            # honesty note: first tokens surface at harvest boundaries
-            # either way; under chunked prefill ADMISSION additionally
-            # progressed one chunk per serving step, so TTFT includes
-            # the interleaved decode steps (that interleaving is the
-            # point — decode never stalled for a whole prompt)
-            granularity = ("harvest cadence, chunk-granularity "
-                           "admission" if "prefill_chunks" in sv
-                           else "harvest cadence")
+            # honesty note: "exact" TTFTs are admitted-event-to-
+            # prefill-span wall time — no harvest quantization — but
+            # under chunked prefill ADMISSION still progressed one
+            # chunk per serving step, so TTFT includes the interleaved
+            # decode steps (that interleaving is the point — decode
+            # never stalled for a whole prompt); "completion"-sourced
+            # TTFTs (old streams) stay harvest-quantized
+            if t.get("source") == "exact":
+                granularity = ("exact admit-to-first-token spans"
+                               + (", chunk-granularity admission"
+                                  if "prefill_chunks" in sv else ""))
+            else:
+                granularity = ("quantized to the harvest cadence"
+                               + (", chunk-granularity admission"
+                                  if "prefill_chunks" in sv else ""))
             lines.append(
                 f"  time-to-first-token: p50 {t['p50']}s  "
                 f"p95 {t['p95']}s  max {t['max']}s "
-                f"(quantized to the {granularity})")
+                f"({granularity})")
         if "requests" in sv:
             r = sv["requests"]
             by = "  ".join(f"{k}={v}"
@@ -406,6 +510,34 @@ def format_report(summary: Dict[str, Any]) -> str:
                 f"{px['pages_shared']} pages shared, "
                 f"{px['prefill_tokens_skipped']} prefill tokens "
                 f"skipped, {px['pages_copied']} CoW copies")
+    fl = summary.get("fleet")
+    if fl:
+        lines.append("fleet summary:")
+        if "routed" in fl:
+            routed = "  ".join(f"{k}={v}"
+                               for k, v in sorted(fl["routed"].items()))
+            lines.append(
+                f"  routed: {routed} "
+                f"(affinity hits {fl.get('affinity_routed', 0)})")
+        ledger = "  ".join(
+            f"{k}={fl[k]}" for k in ("rejected", "migrated",
+                                     "replicas_dead") if k in fl)
+        if ledger:
+            lines.append(f"  ledger: {ledger}")
+        tr = fl.get("trace")
+        if tr:
+            lines.append(
+                f"  trace: {tr['completed']}/{tr['requests']} "
+                f"completed, {tr['lost']} lost")
+        for name, c in (fl.get("by_class") or {}).items():
+            row = f"  [{name}] n={c['n']}"
+            if "ttft_s" in c:
+                row += (f"  ttft p50 {c['ttft_s']['p50']}s "
+                        f"p99 {c['ttft_s']['p99']}s")
+            if "itl_ms" in c:
+                row += (f"  itl p50 {c['itl_ms']['p50']}ms "
+                        f"p99 {c['itl_ms']['p99']}ms")
+            lines.append(row)
     ev = summary.get("events")
     if ev:
         lines.append("events: " + "  ".join(
