@@ -103,17 +103,41 @@ class RequestLog:
         self._entries[request.uid] = e
         return e
 
+    @staticmethod
+    def _check_budget(e: "LogEntry") -> None:
+        """The log's core invariant: a request never commits more than
+        its budget.  The serving loop enforces it per step — one token
+        per harvest record in the plain window, and the speculative
+        window's device-side cap on multi-token commits
+        (``n_commit <= steps_left``) — so a violation here means a
+        broken batcher, and the resume math downstream
+        (:func:`resume_request`) would turn it into a nonsensical
+        negative budget.  Fail at the recording boundary instead, where
+        the offending replica is still known."""
+        if len(e.emitted) > e.request.max_new_tokens:
+            raise ValueError(
+                f"uid {e.request.uid!r} over-committed: "
+                f"{len(e.emitted)} tokens recorded against a budget of "
+                f"{e.request.max_new_tokens} on replica "
+                f"{e.replica!r} — a multi-token (speculative) advance "
+                "must be capped at the slot's remaining budget")
+
     def record_progress(self, replica: str,
                         progress: Dict[Any, List[int]],
                         now: float) -> None:
         """Fold one replica's post-harvest ``progress()`` into the log:
         ``emitted`` becomes the migration-committed tokens plus the
-        current holder's harvested stream."""
+        current holder's harvested stream.  ``toks`` may grow by any
+        number of tokens between calls — the speculative window
+        commits up to k+1 per verify step — the log stores streams,
+        not step counts, so multi-token advances need no special
+        casing beyond the budget invariant check."""
         for uid, toks in progress.items():
             e = self._entries.get(uid)
             if e is None or e.done or e.replica != replica:
                 continue
             e.emitted = e.replayed + list(toks)
+            self._check_budget(e)
             if e.emitted and e.t_first is None:
                 e.t_first = now
 
@@ -121,6 +145,7 @@ class RequestLog:
                  now: float) -> LogEntry:
         e = self._entries[uid]
         e.emitted = e.replayed + list(tokens)
+        self._check_budget(e)
         if e.emitted and e.t_first is None:
             e.t_first = now
         e.done, e.reason, e.t_done = True, reason, now
@@ -149,7 +174,18 @@ def resume_request(entry: LogEntry) -> Request:
     prompt suffix, the budget shrinks by their count, uid and seed are
     unchanged.  Absolute positions (and therefore the key-schedule
     folds) match the original run's, so the continuation reproduces the
-    stream the dead replica would have produced."""
+    stream the dead replica would have produced.
+
+    The math is by token COUNT, not by harvest-record or step count —
+    which is what keeps it exact under speculative decoding, where one
+    verify step commits a variable number of tokens and the record/step
+    ledgers diverge from the stream length.  Token-identity survives
+    too: the Gumbel-coupled acceptance rule commits exactly the tokens
+    the plain per-position key schedule would draw, so a resumed
+    replica re-drafting from a different mid-stream point converges on
+    the same stream regardless of how the dead replica's verify-step
+    boundaries fell (the speculative kill-drill in
+    tests/test_speculative.py pins this)."""
     base = entry.request
     emitted = list(entry.emitted)
     budget = base.max_new_tokens - len(emitted)

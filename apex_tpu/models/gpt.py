@@ -68,7 +68,10 @@ class GPTDecodeFns:
     objects (their ``_cache_size()`` is what the no-recompile tests
     assert on).  ``chunk``/``chunk_jit`` are the chunked-prefill step
     (present only when ``decode_fns(prefill_chunk=C)`` asked for it)
-    and ``prefill_chunk`` its chunk size."""
+    and ``prefill_chunk`` its chunk size.  ``spec``/``spec_jit`` are
+    the speculative verify-and-commit step (present only when
+    ``decode_fns(speculate_k=K)`` asked for it) and ``speculate_k``
+    its fixed draft budget per step."""
 
     prefill: Any
     decode: Any
@@ -82,6 +85,9 @@ class GPTDecodeFns:
     chunk: Any = None
     chunk_jit: Any = None
     prefill_chunk: Any = None
+    spec: Any = None
+    spec_jit: Any = None
+    speculate_k: Any = None
 
 
 @dataclasses.dataclass
@@ -909,6 +915,122 @@ class GPTModel:
         logits = self.logits(params, x.astype(c.compute_dtype))[:, 0]
         return logits, new_pools
 
+    def verify_step(
+        self,
+        params: Dict[str, Any],
+        tokens: jnp.ndarray,
+        lengths: jnp.ndarray,
+        active: jnp.ndarray,
+        valid: jnp.ndarray,
+        page_table: jnp.ndarray,
+        pools: Dict[str, jnp.ndarray],
+        *,
+        quantized: bool = False,
+        kv_block: int = 128,
+    ):
+        """ONE speculative verify step: :meth:`decode_step` widened to
+        ``R = k + 1`` token rows per slot, ONE weight stream for all of
+        them.  ``tokens (S, R)`` is each slot's current token followed
+        by its k draft tokens, sitting at absolute positions
+        ``lengths[s] .. lengths[s] + R - 1``; ``valid (S, R)`` masks
+        the real rows (row 0 plus the slot's actual draft length —
+        shapes stay fixed at R for every acceptance pattern, padding
+        rows write to the null page).  Each layer writes the rows' K/V
+        into the slot's pages first (the :meth:`prefill_chunk`
+        write-before-attend pattern) and attends through
+        :func:`~apex_tpu.ops.attention_decode.fmha_decode`'s small-s_q
+        path, per-row causal at ``lengths + i`` — row i sees the
+        committed cache plus draft rows 0..i, exactly the
+        autoregressive prefix.  Returns ``(logits (S, R, vocab/tp),
+        new_pools)``: row j's logits predict the token AFTER j
+        committed drafts, so the caller can accept a draft prefix and
+        take its correction/bonus token from the same pass.
+
+        Rejection needs no cleanup here: the caller simply advances
+        ``lengths`` by the accepted count, the kernel never attends
+        past a slot's length, and the next step's write range covers
+        the stale rows.  Draft rows that would land past the slot's
+        logical page extent are masked to the null page (a clamped
+        gather would otherwise wrap them into the LAST real page, over
+        committed data) — the serving driver additionally caps draft
+        length under the slot's remaining budget so live rows never
+        overrun."""
+        from apex_tpu.ops.attention_decode import fmha_decode
+        from apex_tpu.serving.kv_cache import write_targets, write_tokens
+
+        c = self.config
+        if self.moe is not None:
+            raise NotImplementedError("MoE decode is not supported")
+        S, R = tokens.shape
+        page_size = pools["k"].shape[3]
+        lengths = lengths.astype(jnp.int32)
+        positions = lengths[:, None] + jnp.arange(R, dtype=jnp.int32)[None]
+        max_len = page_table.shape[1] * page_size
+        writev = valid & active[:, None] & (positions < max_len)
+
+        x = self.embedding.apply(params["embedding"], tokens)
+        if c.position_embedding == "learned":
+            pos = jnp.clip(positions, 0, c.max_position_embeddings - 1)
+            x = x + jnp.take(
+                params["pos_embedding"], pos, axis=0).astype(x.dtype)
+        x = x.astype(c.compute_dtype)
+
+        rope_cs = None
+        if c.position_embedding == "rope":
+            from apex_tpu.ops.rope import rope_table
+
+            # (S, R, d/2): per-row rotation gathered from the same
+            # cached full table as decode_step/prefill_chunk, so the
+            # verify rows rotate bit-identically to the one-token path
+            cos_t, sin_t = rope_table(max_len, c.head_dim,
+                                      base=c.rope_base)
+            pos = jnp.clip(positions, 0, max_len - 1)
+            rope_cs = (jnp.take(cos_t, pos, axis=0),
+                       jnp.take(sin_t, pos, axis=0))
+
+        # the kernel's per-row causal mask sits at lengths - R + i
+        # relative to attend = lengths + R, i.e. row i attends through
+        # position lengths + i — write-before-attend covers it
+        attend = jnp.where(active, lengths + R, 0).astype(jnp.int32)
+        wp, wo = write_targets(page_table, positions, writev, page_size)
+        decode_impl = "xla" if c.attention_impl == "xla" else None
+
+        def body(x, scanned):
+            lp, pool_l = scanned
+            residual = x
+            y = self._norm(lp["ln1"], x).astype(c.compute_dtype)
+            q, k, v = self._qkv_heads(lp, y)      # (S, hl, R, d)
+            if rope_cs is not None:
+                from apex_tpu.ops.rope import apply_rope_tables
+
+                k = apply_rope_tables(
+                    k, rope_cs[0][:, None], rope_cs[1][:, None])
+            # (S, hl, R, d) -> (S*R, hl, d) token rows, row-major to
+            # match wp/wo.reshape(-1)
+            pool_l = write_tokens(
+                pool_l,
+                jnp.moveaxis(k, 1, 2).reshape(S * R, -1, k.shape[-1]),
+                jnp.moveaxis(v, 1, 2).reshape(S * R, -1, v.shape[-1]),
+                wp.reshape(-1), wo.reshape(-1),
+                quantized=quantized, kv_block=kv_block)
+            attn = fmha_decode(
+                q, pool_l["k"], pool_l["v"], page_table, attend,
+                causal=True, k_scales=pool_l.get("k_scales"),
+                v_scales=pool_l.get("v_scales"), kv_block=kv_block,
+                rope=rope_cs, implementation=decode_impl)
+            attn = jnp.moveaxis(attn, 1, 2).reshape(S, R, -1)
+            out = self.attn_proj.apply(lp["attn_proj"], attn)
+            x = residual + out.astype(residual.dtype)
+            residual = x
+            y = self._norm(lp["ln2"], x).astype(c.compute_dtype)
+            y = self._dense_mlp(lp, y)
+            return residual + y.astype(residual.dtype), pool_l
+
+        x, new_pools = jax.lax.scan(body, x, (params["layers"], pools))
+        x = self._norm(params["final_ln"], x.astype(jnp.float32))
+        logits = self.logits(params, x.astype(c.compute_dtype))
+        return logits, new_pools
+
     def decode_fns(
         self,
         params: Dict[str, Any],
@@ -921,13 +1043,24 @@ class GPTModel:
         top_p: Optional[float] = None,
         eos_id: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
+        speculate_k: Optional[int] = None,
+        draft_model: Optional[Any] = None,
     ):
         """Build the jitted serving step functions the
         continuous-batching driver
         (:class:`apex_tpu.serving.serve.ContinuousBatcher`) runs:
         ``(prefill, decode)``, plus a chunked-prefill step when
         ``prefill_chunk`` (a chunk size in tokens) is given — the
-        :meth:`prefill_chunk` path the stall-free scheduler drives.
+        :meth:`prefill_chunk` path the stall-free scheduler drives —
+        plus a speculative verify-and-commit step when ``speculate_k``
+        (the per-step draft budget) is given: :meth:`verify_step` at
+        ``s_q = k + 1`` followed by the fused Gumbel-coupled
+        acceptance rule (:func:`apex_tpu.serving.sampling.spec_accept`)
+        and an in-jit multi-token commit (lengths/steps_left/done all
+        advance by the accepted count).  ``draft_model`` is the seam
+        for a future small shared-tokenizer draft model and currently
+        raises — self-speculation (host n-gram drafting,
+        :mod:`apex_tpu.serving.speculate`) is the shipping source.
 
         All close over nothing dynamic: params ride as an argument
         through ONE jit each, every other shape comes from
@@ -950,13 +1083,21 @@ class GPTModel:
         from apex_tpu.serving.kv_cache import (
             init_pools, write_targets, write_tokens,
         )
-        from apex_tpu.serving.sampling import sample
+        from apex_tpu.serving.sampling import sample, spec_accept
         from apex_tpu.transformer import parallel_state
         from apex_tpu._compat import shard_map
 
         c = self.config
         if self.moe is not None:
             raise NotImplementedError("MoE decode is not supported")
+        if draft_model is not None:
+            raise NotImplementedError(
+                "draft-model speculation is a stub: the verify step, "
+                "acceptance rule and multi-token serving schedule are "
+                "draft-source-agnostic, but running a second model's "
+                "decode loop per step is not wired up — use "
+                "self-speculation (speculate_k=K with the host n-gram "
+                "draft source, apex_tpu.serving.speculate)")
         if parallel_state.get_tensor_model_parallel_world_size() > 1 or \
                 parallel_state.get_pipeline_model_parallel_world_size() > 1:
             raise NotImplementedError(
@@ -1048,6 +1189,68 @@ class GPTModel:
                 "sample_keys": carry["sample_keys"],
             }
 
+        def _spec(params, pools, carry, page_table, drafts, draft_len):
+            # verify-and-commit: k+1 rows through ONE weight stream,
+            # then the fused acceptance rule, then a multi-token carry
+            # advance — all inside the jit, fixed shapes for every
+            # draft length and acceptance pattern
+            K = int(speculate_k)
+            R = K + 1
+            active = jnp.logical_not(carry["done"])
+            lengths = carry["lengths"]
+            jrow = jnp.arange(R, dtype=jnp.int32)[None]       # (1, R)
+            rows = jnp.concatenate(
+                [carry["tokens"][:, None], drafts.astype(jnp.int32)],
+                axis=1)                                        # (S, R)
+            valid = jrow <= draft_len[:, None]
+            logits, pools = self.verify_step(
+                params, rows, lengths, active, valid, page_table,
+                pools, quantized=cfg.quantized, kv_block=cfg.kv_block)
+            # row j's draw sits after lengths + 1 + j context tokens —
+            # fold exactly what the plain one-token loop would fold at
+            # that position, so the committed stream is key-schedule
+            # identical to non-speculative sampling (and to a failover
+            # replay that re-enters anywhere in the stream)
+            ctx = jnp.where(active[:, None], lengths[:, None] + 1 + jrow,
+                            0)
+            keys = jax.vmap(
+                jax.vmap(jax.random.fold_in, in_axes=(None, 0))
+            )(carry["sample_keys"], ctx)
+            targets, n_acc = jax.vmap(
+                lambda l, dr, dl, kk: spec_accept(
+                    l, dr, dl, kk, temperature, top_k, top_p)
+            )(logits, drafts, draft_len, keys)
+            # commit = accepted drafts + the correction/bonus row, cut
+            # at the first committed EOS and capped at the slot's
+            # remaining budget — the same freeze rules as _decode,
+            # applied to a variable-length advance
+            raw = n_acc + 1
+            is_eos = ((targets == eos_id) if eos_id is not None
+                      else jnp.zeros_like(targets, dtype=bool))
+            eos_run = is_eos & (jrow < raw[:, None])
+            any_eos = jnp.any(eos_run, axis=1)
+            first_eos = jnp.argmax(eos_run, axis=1).astype(jnp.int32)
+            n_c = jnp.where(any_eos, first_eos + 1, raw)
+            n_c = jnp.minimum(n_c, carry["steps_left"])
+            n_c = jnp.where(active, n_c, 0).astype(jnp.int32)
+            last = jnp.take_along_axis(
+                targets, jnp.clip(n_c - 1, 0, R - 1)[:, None],
+                axis=1)[:, 0]
+            tokens = jnp.where(active, last, carry["tokens"])
+            steps_left = carry["steps_left"] - n_c
+            eos_committed = jnp.any(
+                is_eos & (jrow < n_c[:, None]), axis=1)
+            done = carry["done"] | (
+                active & (eos_committed | (steps_left <= 0)))
+            new_carry = {
+                "tokens": tokens,
+                "lengths": carry["lengths"] + n_c,
+                "steps_left": steps_left,
+                "done": done,
+                "sample_keys": carry["sample_keys"],
+            }
+            return pools, new_carry, targets, n_c
+
         from apex_tpu.serving.serve import init_carry
 
         carry_tmpl = init_carry(cfg.max_seqs)
@@ -1106,6 +1309,45 @@ class GPTModel:
             # of ITS size and must reject a step compiled for another
             chunk.prefill_chunk = C
 
+        spec = sj = None
+        if speculate_k is not None:
+            from apex_tpu.ops.attention_decode import (
+                FMHA_DECODE_MAX_ROWS,
+            )
+
+            K = int(speculate_k)
+            if K < 1:
+                raise ValueError(
+                    f"speculate_k must be >= 1, got {speculate_k}")
+            if K + 1 > FMHA_DECODE_MAX_ROWS:
+                raise ValueError(
+                    f"speculate_k {K} puts the verify step at "
+                    f"{K + 1} rows, past the decode kernel's "
+                    f"per-program row budget "
+                    f"(FMHA_DECODE_MAX_ROWS={FMHA_DECODE_MAX_ROWS}); "
+                    "acceptance saturates long before that anyway "
+                    "(docs/serving.md, k-selection)")
+            sj = jax.jit(shard_map(
+                _spec, mesh=mesh,
+                in_specs=(specs, pool_specs, rep(carry_tmpl), P(), P(),
+                          P()),
+                out_specs=(pool_specs, rep(carry_tmpl), P(), P()),
+            ))
+
+            def spec(pools, carry, pt, drafts, draft_len, _sj=sj,
+                     _K=K):
+                drafts = jnp.asarray(drafts, jnp.int32).reshape(
+                    cfg.max_seqs, _K)
+                draft_len = jnp.asarray(draft_len, jnp.int32).reshape(
+                    cfg.max_seqs)
+                return _sj(params, pools, carry, pt, drafts, draft_len)
+
+            # stamped like decode.eos_id / chunk.prefill_chunk: the
+            # batcher drafts at ITS k and must reject a verify step
+            # compiled for another, or for a different freeze id
+            spec.eos_id = eos_id
+            spec.speculate_k = K
+
         return GPTDecodeFns(
             prefill=prefill,
             decode=decode,
@@ -1116,6 +1358,10 @@ class GPTModel:
             chunk_jit=cj,
             prefill_chunk=(None if prefill_chunk is None
                            else int(prefill_chunk)),
+            spec=spec,
+            spec_jit=sj,
+            speculate_k=(None if speculate_k is None
+                         else int(speculate_k)),
         )
 
     def generate(
@@ -1140,6 +1386,8 @@ class GPTModel:
         logger: Optional[Any] = None,
         prefill_chunk: Optional[int] = None,
         prefix_cache: bool = False,
+        speculate_k: Optional[int] = None,
+        draft_source: Optional[Any] = None,
     ):
         """Generate from ``prompts (b, s)`` (right-padded; real lengths
         in ``prompt_lengths``) through the full serving stack — paged
@@ -1150,8 +1398,12 @@ class GPTModel:
         ``prefill_chunk`` switches prompt ingestion to the stall-free
         chunked scheduler (docs/serving.md) and ``prefix_cache``
         additionally shares identical prompt prefixes across requests.
-        Returns the per-prompt generated token lists (EOS included
-        when hit)."""
+        ``speculate_k`` turns on draft-and-verify speculative decoding
+        (k host-drafted tokens verified per weight stream; the token
+        streams stay identical — docs/serving.md), drafting from
+        ``draft_source`` (default n-gram self-speculation).  Returns
+        the per-prompt generated token lists (EOS included when
+        hit)."""
         import numpy as np
 
         from apex_tpu.serving.kv_cache import (
@@ -1182,13 +1434,16 @@ class GPTModel:
         fns = self.decode_fns(
             params, mesh, ccfg, max_prompt_len=s,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            eos_id=eos_id, prefill_chunk=prefill_chunk)
+            eos_id=eos_id, prefill_chunk=prefill_chunk,
+            speculate_k=speculate_k)
         batcher = ContinuousBatcher(
             fns.prefill, fns.decode, PagedKVCache(ccfg),
             init_pools(ccfg), max_prompt_len=s,
             harvest_every=harvest_every, eos_id=eos_id, key=key,
             logger=logger, chunk_fn=fns.chunk,
-            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache)
+            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+            spec_fn=fns.spec, speculate_k=fns.speculate_k,
+            draft_source=draft_source)
         reqs = [
             Request(uid=i,
                     prompt=[int(t) for t in

@@ -29,6 +29,7 @@ _LAZY_ATTRS = {
     "kv_cache": "apex_tpu.serving.kv_cache",
     "sampling": "apex_tpu.serving.sampling",
     "serve": "apex_tpu.serving.serve",
+    "speculate": "apex_tpu.serving.speculate",
     "KVCacheConfig": "apex_tpu.serving.kv_cache",
     "PageAllocator": "apex_tpu.serving.kv_cache",
     "PagedKVCache": "apex_tpu.serving.kv_cache",
@@ -40,6 +41,11 @@ _LAZY_ATTRS = {
     "copy_pages": "apex_tpu.serving.kv_cache",
     "greedy": "apex_tpu.serving.sampling",
     "sample": "apex_tpu.serving.sampling",
+    "spec_accept": "apex_tpu.serving.sampling",
+    "DraftSource": "apex_tpu.serving.speculate",
+    "NGramDraftSource": "apex_tpu.serving.speculate",
+    "NullDraftSource": "apex_tpu.serving.speculate",
+    "ModelDraftSource": "apex_tpu.serving.speculate",
     "Request": "apex_tpu.serving.serve",
     "Completion": "apex_tpu.serving.serve",
     "ContinuousBatcher": "apex_tpu.serving.serve",
@@ -54,7 +60,8 @@ def __getattr__(name):
         import importlib
 
         mod = importlib.import_module(_LAZY_ATTRS[name])
-        val = (mod if name in ("kv_cache", "sampling", "serve")
+        val = (mod if name in ("kv_cache", "sampling", "serve",
+                               "speculate")
                else getattr(mod, name))
         globals()[name] = val
         return val

@@ -514,18 +514,25 @@ def write_targets(
 
     ``page_table`` is one slot's row ``(pages_per_seq,)`` (prefill:
     ``positions`` are the prompt's ``(n,)`` token indices) or the full
-    ``(slots, pages_per_seq)`` table (decode: ``positions[i]`` is slot
-    ``i``'s current position).  Invalid entries (padding, idle slots)
+    ``(slots, pages_per_seq)`` table, with ``positions`` either
+    ``(slots,)`` (decode: slot ``i``'s current position) or
+    ``(slots, rows)`` (a verify step: each slot writes its current
+    token plus k draft rows at consecutive positions).  Invalid entries
+    (padding, idle slots, draft rows past the slot's real draft length)
     are redirected to the null page; a position past the slot's last
     logical page clamps (jax gather semantics) — by construction that
     only happens to finished slots decoding out a harvest window, whose
-    writes are garbage by contract."""
+    writes are garbage by contract (speculative callers additionally
+    mask ``valid`` at the table's logical extent so an overrun draft
+    row can never clamp INTO a live slot's committed pages)."""
     positions = positions.astype(jnp.int32)
     idx = positions // page_size
     if page_table.ndim == 1:
         phys = jnp.take(page_table, idx)
-    else:
+    elif idx.ndim == 1:
         phys = jnp.take_along_axis(page_table, idx[:, None], axis=1)[:, 0]
+    else:
+        phys = jnp.take_along_axis(page_table, idx, axis=1)
     zero = jnp.zeros_like(phys)
     return (
         jnp.where(valid, phys, zero).astype(jnp.int32),

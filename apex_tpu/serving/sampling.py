@@ -24,7 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["greedy", "sample"]
+__all__ = ["greedy", "sample", "spec_accept"]
 
 _NEG_INF = -1e30
 
@@ -93,3 +93,73 @@ def sample(
     g = jax.random.gumbel(key, x.shape, jnp.float32)
     # floored entries sit at -1e30; a Gumbel draw cannot bridge that
     return jnp.argmax(x + g, axis=-1).astype(jnp.int32)
+
+
+def spec_accept(
+    logits: jnp.ndarray,
+    drafts: jnp.ndarray,
+    draft_len: jnp.ndarray,
+    keys: Optional[jnp.ndarray],
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+):
+    """Fused speculative accept/commit for ONE slot's verify step.
+
+    ``logits (R, vocab)`` are the verify step's R = k+1 rows (row j
+    predicts the token after j committed drafts), ``drafts (R-1,)`` the
+    proposed tokens, ``draft_len ()`` how many are real, and
+    ``keys (R, ...)`` the per-row PRNG keys — the slot key folded with
+    the row's ABSOLUTE context length, i.e. exactly the key the plain
+    one-token decode loop would use for that position.  Returns
+    ``(targets (R,) int32, n_accept () int32)``: the per-row target
+    draws and the length of the accepted draft prefix.  The caller
+    commits ``targets[:n_accept + 1]`` — the accepted drafts plus one
+    bonus/correction token, all from a single weight stream.
+
+    **Why this is distribution-preserving.**  The textbook rule
+    (accept draft d_j w.p. ``min(1, p(d_j)/q(d_j))``, else resample the
+    residual ``max(p − q, 0)``) preserves the target distribution p for
+    ANY draft distribution q.  Here the draft is a deterministic
+    function of the committed context (n-gram lookup: q is a point
+    mass at d_j), and we couple the accept/reject coin and the residual
+    resample to the SAME Gumbel draw the plain sampler would make:
+    ``targets[j] = argmax(x_j + G_j)`` with ``G_j`` keyed by absolute
+    position.  Row j commits the draft iff ``d_j == targets[j]`` — for
+    a point-mass q that IS ``min(1, p/q)`` acceptance (the event has
+    probability p(d_j)), and on rejection the committed correction
+    ``targets[j]`` is distributed as p restricted to ≠ d_j... which is
+    the residual ``max(p − q, 0)`` renormalized.  So acceptance is
+    distribution-preserving AND the committed stream is token-identical
+    to the plain sampler under the same key schedule (each committed
+    position's token is ``argmax(x + G)`` for the same x and same G in
+    both paths) — which is what keeps fleet failover migration and the
+    cross-replica determinism contract exact under variable-length
+    advances, and makes the dryrun's sampled-equality gate a bitwise
+    comparison instead of a statistical test.
+
+    ``temperature=0`` reduces to exact greedy prefix match: accept
+    while the draft equals the argmax, then commit the argmax row.
+    Temperature / top-k / top-p all apply per row BEFORE the draw, so
+    their semantics survive speculation unchanged.
+    """
+    if logits.ndim != 2:
+        raise ValueError(f"logits must be (rows, vocab), got {logits.shape}")
+    rows = logits.shape[0]
+    if drafts.shape != (rows - 1,):
+        raise ValueError(
+            f"drafts must be ({rows - 1},) for {rows} logit rows, got "
+            f"{drafts.shape}")
+    if temperature == 0.0:
+        targets = greedy(logits)
+    else:
+        if keys is None:
+            raise ValueError("temperature > 0 requires per-row PRNG keys")
+        targets = jax.vmap(
+            lambda l, kk: sample(l[None], kk, temperature, top_k, top_p)[0]
+        )(logits, keys)
+    j = jnp.arange(rows - 1, dtype=jnp.int32)
+    match = (drafts.astype(jnp.int32) == targets[:-1]) & (j < draft_len)
+    # longest accepted PREFIX: one mismatch rejects everything after it
+    n_accept = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
+    return targets, n_accept.astype(jnp.int32)

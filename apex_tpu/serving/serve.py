@@ -193,6 +193,23 @@ class ContinuousBatcher:
     fill the ``decode_stall_s`` / ``max_prefill_stall_s`` counters
     (real wall time, for the bench/dryrun comparisons; off by default
     to keep dispatches async).
+
+    **Speculative decoding** (``spec_fn`` + ``speculate_k``, built by
+    ``decode_fns(speculate_k=K)``): each serving step drafts up to K
+    tokens per live slot from a host-side ``draft_source`` (default
+    :class:`~apex_tpu.serving.speculate.NGramDraftSource`; a
+    :class:`~apex_tpu.serving.speculate.NullDraftSource` degrades to
+    plain one-token decode), runs the verify-and-commit step
+    (``spec_fn(pools, carry, page_table, drafts (S, K) i32, draft_len
+    (S,) i32) -> (pools, carry, targets (S, K+1) i32, n_commit (S,)
+    i32)``), and commits a VARIABLE number of tokens per slot under the
+    same fixed shapes — zero recompiles across every acceptance
+    pattern.  Because drafting needs the committed context, the
+    speculative window resolves each step's commits on the spot (one
+    small sync per verify step, ``harvest_every`` bounds steps per
+    window as usual); budget accounting is exact by host count, so
+    harvest/:meth:`progress`/fleet failover see multi-token advances
+    correctly.
     """
 
     def __init__(
@@ -211,6 +228,9 @@ class ContinuousBatcher:
         prefill_chunk: Optional[int] = None,
         prefix_cache: bool = False,
         measure_stall: bool = False,
+        spec_fn: Optional[Callable] = None,
+        speculate_k: Optional[int] = None,
+        draft_source: Optional[Any] = None,
     ):
         if harvest_every < 1:
             raise ValueError("harvest_every must be >= 1")
@@ -246,6 +266,46 @@ class ContinuousBatcher:
                 "prefix_cache requires chunked prefill (the monolithic "
                 "prefill recomputes every position and cannot skip "
                 "matched chunks)")
+        if (spec_fn is None) != (speculate_k is None):
+            raise ValueError(
+                "speculative decoding needs BOTH spec_fn and "
+                "speculate_k (decode_fns(speculate_k=K) builds the "
+                "pair)")
+        if spec_fn is not None:
+            if int(speculate_k) < 1:
+                raise ValueError(
+                    f"speculate_k must be >= 1, got {speculate_k}")
+            fn_k = getattr(spec_fn, "speculate_k", _unset)
+            if fn_k is not _unset and int(fn_k) != int(speculate_k):
+                raise ValueError(
+                    f"speculate_k mismatch: spec_fn was compiled for "
+                    f"k={fn_k} drafts but the batcher schedules "
+                    f"k={speculate_k}")
+            fn_spec_eos = getattr(spec_fn, "eos_id", _unset)
+            if fn_spec_eos is not _unset and fn_spec_eos != eos_id:
+                raise ValueError(
+                    f"eos_id mismatch: spec_fn freezes slots at "
+                    f"{fn_spec_eos!r} but the batcher truncates at "
+                    f"{eos_id!r}")
+        if draft_source is not None and spec_fn is None:
+            raise ValueError(
+                "draft_source without spec_fn — pass "
+                "decode_fns(speculate_k=K)'s spec step too")
+        self.spec_fn = spec_fn
+        self.speculate_k = (None if speculate_k is None
+                            else int(speculate_k))
+        if spec_fn is not None and draft_source is None:
+            from apex_tpu.serving.speculate import NGramDraftSource
+
+            draft_source = NGramDraftSource(self.speculate_k)
+        self.draft_source = draft_source
+        #: host-side speculation scoreboard (the bench rows and the
+        #: accepted-tokens/step gates read it): per-verify-step totals
+        #: plus per-draft-source hit counts
+        self.spec_stats = {
+            "steps": 0, "slot_steps": 0, "drafted": 0, "accepted": 0,
+            "committed": 0, "by_source": {},
+        }
         self.prefill_fn = prefill_fn
         self.decode_fn = decode_fn
         self.chunk_fn = chunk_fn
@@ -512,7 +572,11 @@ class ContinuousBatcher:
         """Decode steps someone can still use: the longest remaining
         budget among live slots, net of the steps each already took
         this window (generated-so-far counts the admit-time first
-        token while it is still an unharvested future)."""
+        token while it is still an unharvested future).  This is
+        one-token-per-step arithmetic — the PLAIN window's invariant;
+        the speculative window commits a variable count per step and
+        does its budget math by exact host count instead
+        (:meth:`_spec_window`)."""
         budget = 0
         for s, m in self._meta.items():
             if m["finished"] is not None:
@@ -523,7 +587,178 @@ class ContinuousBatcher:
             budget = max(budget, rem)
         return budget
 
+    def _absorb_firsts(self, firsts_h, t_h: float) -> None:
+        """Fold resolved admit-time first tokens into the host streams
+        (shared by the plain harvest and the speculative window)."""
+        for slot, tok in firsts_h.items():
+            m = self._meta[slot]
+            m["tokens"].append(int(tok))
+            m["t_first"] = t_h
+            if self.eos_id is not None and int(tok) == self.eos_id:
+                m["finished"] = "eos"
+            elif len(m["tokens"]) >= m["req"].max_new_tokens:
+                m["finished"] = "budget"
+
+    def _retire(self, done_h, t_h: float) -> None:
+        """Retire finished slots: device ``done`` and host finish
+        detection agree by construction (same eos/budget rules); host
+        is authoritative for truncation, device for freezing."""
+        for slot in list(self._meta):
+            m = self._meta[slot]
+            if m["finished"] is None and not bool(done_h[slot]):
+                continue
+            reason = m["finished"] or (
+                "eos" if (self.eos_id is not None and m["tokens"]
+                          and m["tokens"][-1] == self.eos_id)
+                else "budget")
+            req = m["req"]
+            comp = Completion(
+                uid=req.uid, tokens=m["tokens"],
+                prompt_len=len(req.prompt), reason=reason,
+                ttft_s=(None if m["t_first"] is None
+                        else m["t_first"] - m["t_admit"]),
+                duration_s=t_h - m["t_admit"],
+            )
+            self.completions[req.uid] = comp
+            self.cache.retire(slot)
+            c = self.carry
+            self.carry = {**c, "done": c["done"].at[slot].set(True)}
+            del self._meta[slot]
+            self._event("request_done", uid=req.uid, slot=slot,
+                        new_tokens=len(comp.tokens), reason=reason,
+                        ttft_s=(None if comp.ttft_s is None
+                                else round(comp.ttft_s, 6)),
+                        duration_s=round(comp.duration_s, 6))
+
+    def _spec_window(self) -> None:
+        """One harvest window of speculative serving steps: draft on
+        the host, verify-and-commit on device, resolve the commits.
+
+        The plain window stacks ``harvest_every`` one-token steps and
+        resolves them in ONE device_get; here each verify step's
+        commits resolve immediately, because the NEXT step's host-side
+        draft needs them (the pure-host draft seam's cost — one small
+        sync per verify step, amortized over up to k+1 committed
+        tokens).  Budget accounting is exact by host count
+        (``max_new_tokens - len(tokens)``), not by step arithmetic —
+        the one-token-per-step assumption ``_window_budget`` encodes
+        does not survive multi-token advances.  The draft length is
+        additionally capped at remaining-budget − 1 so no live row is
+        ever written past the slot's reserved pages."""
+        k = self.speculate_k
+        S = self.cache.config.max_seqs
+        page_table = jnp.asarray(self.cache.page_table)
+        t0 = time.perf_counter()
+        chunk_s = 0.0
+        steps = kept = 0
+        done_h = None
+        for _ in range(self.harvest_every):
+            did_chunk = False
+            if self._prefilling:
+                chunk_s += self._prefill_step(
+                    next(iter(self._prefilling)))
+                did_chunk = True
+            # resolve pending admit-time first tokens NOW: the draft
+            # source needs the full committed context, and this window
+            # syncs per verify step anyway
+            if self._first_tok:
+                firsts = {s: self._first_tok.pop(s)
+                          for s in list(self._first_tok)}
+                self._absorb_firsts(jax.device_get(firsts),
+                                    time.perf_counter())
+            live = [(s, m) for s, m in self._meta.items()
+                    if m["finished"] is None]
+            if not live:
+                if not did_chunk:
+                    break
+                continue
+            drafts = np.zeros((S, k), np.int32)
+            dlens = np.zeros((S,), np.int32)
+            sources: Dict[int, str] = {}
+            for s, m in live:
+                # exact multi-token budget: cap the draft under the
+                # slot's remaining tokens (the +1 verify bonus row
+                # fills the rest), so the device can never be offered
+                # more rows than the budget admits
+                rem = m["req"].max_new_tokens - len(m["tokens"])
+                cap = min(k, rem - 1)
+                if cap <= 0:
+                    continue
+                toks, src = self.draft_source.draft(
+                    list(m["req"].prompt) + m["tokens"],
+                    len(m["req"].prompt))
+                toks = toks[:cap]
+                if toks:
+                    drafts[s, :len(toks)] = toks
+                    dlens[s] = len(toks)
+                    sources[s] = src
+            with phase("decode"):
+                self.pools, self.carry, out, n_commit = self.spec_fn(
+                    self.pools, self.carry, page_table,
+                    drafts, dlens)
+            out_h, nc_h, done_h = jax.device_get(
+                (out, n_commit, self.carry["done"]))
+            self.steps += 1
+            steps += 1
+            drafted = accepted = committed = 0
+            commits: List[int] = []
+            ev_src: Dict[str, Dict[str, int]] = {}
+            for s, m in live:
+                nc = int(nc_h[s])
+                for j in range(nc):
+                    tok = int(out_h[s, j])
+                    m["tokens"].append(tok)
+                    kept += 1
+                    # host length mirror follows the device's commit
+                    self.cache.lengths[s] += 1
+                    if self.eos_id is not None and tok == self.eos_id:
+                        m["finished"] = "eos"
+                    elif len(m["tokens"]) >= m["req"].max_new_tokens:
+                        m["finished"] = "budget"
+                dl = int(dlens[s])
+                acc = max(min(nc - 1, dl), 0)
+                drafted += dl
+                accepted += acc
+                committed += nc
+                commits.append(nc)
+                src = sources.get(s)
+                if src is not None:
+                    rec = ev_src.setdefault(
+                        src, {"drafted": 0, "accepted": 0})
+                    rec["drafted"] += dl
+                    rec["accepted"] += acc
+            st = self.spec_stats
+            st["steps"] += 1
+            st["slot_steps"] += len(live)
+            st["drafted"] += drafted
+            st["accepted"] += accepted
+            st["committed"] += committed
+            for src, rec in ev_src.items():
+                tot = st["by_source"].setdefault(
+                    src, {"drafted": 0, "accepted": 0})
+                tot["drafted"] += rec["drafted"]
+                tot["accepted"] += rec["accepted"]
+            # one spec_accept event per verify step, built entirely
+            # from the commit resolve this loop already performs — no
+            # host syncs beyond the per-step one the draft seam needs
+            self._event("spec_accept", slots=len(live),
+                        drafted=drafted, accepted=accepted,
+                        committed=committed, commits=commits,
+                        by_source=ev_src)
+        t_h = time.perf_counter()
+        self.windows += 1
+        if done_h is None:
+            done_h = jax.device_get(self.carry["done"])
+        self._event(
+            "span", span="decode", steps=steps,
+            slots=len(self._meta), tokens=kept,
+            dur_s=round(max(t_h - t0 - chunk_s, 0.0), 6),
+        )
+        self._retire(done_h, t_h)
+
     def _decode_window(self) -> None:
+        if self.spec_fn is not None:
+            return self._spec_window()
         base = self.steps
         page_table = jnp.asarray(self.cache.page_table)
         window: List[jnp.ndarray] = []
@@ -555,14 +790,7 @@ class ContinuousBatcher:
         t_h = time.perf_counter()
         self.windows += 1
 
-        for slot, tok in firsts_h.items():
-            m = self._meta[slot]
-            m["tokens"].append(int(tok))
-            m["t_first"] = t_h
-            if self.eos_id is not None and int(tok) == self.eos_id:
-                m["finished"] = "eos"
-            elif len(m["tokens"]) >= m["req"].max_new_tokens:
-                m["finished"] = "budget"
+        self._absorb_firsts(firsts_h, t_h)
         kept = 0
         for i in range(steps):
             for slot, m in self._meta.items():
@@ -594,35 +822,7 @@ class ContinuousBatcher:
             dur_s=round(max(t_h - t0 - chunk_s, 0.0), 6),
         )
 
-        # ---- retire: device `done` and host finish detection agree by
-        # construction (same eos/budget rules); host is authoritative
-        # for truncation, device for freezing
-        for slot in list(self._meta):
-            m = self._meta[slot]
-            if m["finished"] is None and not bool(done_h[slot]):
-                continue
-            reason = m["finished"] or (
-                "eos" if (self.eos_id is not None and m["tokens"]
-                          and m["tokens"][-1] == self.eos_id)
-                else "budget")
-            req = m["req"]
-            comp = Completion(
-                uid=req.uid, tokens=m["tokens"],
-                prompt_len=len(req.prompt), reason=reason,
-                ttft_s=(None if m["t_first"] is None
-                        else m["t_first"] - m["t_admit"]),
-                duration_s=t_h - m["t_admit"],
-            )
-            self.completions[req.uid] = comp
-            self.cache.retire(slot)
-            c = self.carry
-            self.carry = {**c, "done": c["done"].at[slot].set(True)}
-            del self._meta[slot]
-            self._event("request_done", uid=req.uid, slot=slot,
-                        new_tokens=len(comp.tokens), reason=reason,
-                        ttft_s=(None if comp.ttft_s is None
-                                else round(comp.ttft_s, 6)),
-                        duration_s=round(comp.duration_s, 6))
+        self._retire(done_h, t_h)
 
     # ------------------------------------------------------------ cancel
     def cancel(self, uid: Any) -> Optional[List[int]]:

@@ -1,0 +1,166 @@
+"""Host-side draft sources for speculative decoding.
+
+Decode is bandwidth-bound: every generated token streams the full
+weights plus the slot's KV once, so the one-token-per-step loop IS the
+small-batch roofline.  Speculative decoding buys k tokens per weight
+stream by splitting the step in two: a cheap DRAFT proposes k
+candidate tokens, the model VERIFIES all k (plus the bonus row after
+them) in one ``fmha_decode`` pass at ``s_q = k + 1``
+(``GPTModel.verify_step``), and the fused sampler commits the longest
+prefix the model agrees with (``sampling.spec_accept``).  The paged
+cache makes rejection free: drafted K/V rows past the committed length
+are simply never attended (the kernel masks at ``lengths``) and the
+next step overwrites them — rollback is a length truncation, no data
+movement.
+
+This module is the DRAFT half, and it is pure host Python: a draft
+source sees only the committed token stream (prompt + harvested
+output) and proposes up to k continuation tokens per slot.  The
+shipping source is **self-speculation** — n-gram / prompt-lookup
+drafting with zero extra weights:
+
+- :class:`NGramDraftSource` matches the context's trailing n-gram
+  against every earlier occurrence in prompt + emitted tokens and
+  proposes the tokens that followed the most recent match.  This wins
+  exactly the summarize / extract / code-edit scenarios where the
+  output copies spans of the input ("prompt_lookup" hits) or repeats
+  its own phrasing ("ngram" hits) — and degrades to an empty draft
+  (one token per step, the plain decode rate) on adversarial prompts
+  with no repetition.
+- :class:`NullDraftSource` never drafts — the speculative step then
+  commits exactly one token per weight stream, which is the reference
+  the rollback bit-identity tests compare against.
+- :class:`ModelDraftSource` is the ``draft_model=`` seam: a future
+  small shared-tokenizer draft model slots in here (draft with the
+  small model, verify with the big one).  It raises loudly until that
+  model exists.
+
+Because drafting is host-side, the speculative serving loop resolves
+each verify step's committed tokens before drafting the next — one
+small sync per verify step, amortized over the whole accepted run
+(``serve.ContinuousBatcher._spec_window``; docs/serving.md discusses
+the trade against the plain window's harvest cadence).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "DraftSource",
+    "NGramDraftSource",
+    "NullDraftSource",
+    "ModelDraftSource",
+]
+
+
+class DraftSource:
+    """Protocol: propose up to ``k`` continuation tokens for one slot.
+
+    ``draft(context, prompt_len)`` receives the COMMITTED stream
+    (prompt + harvested tokens, in order) and the prompt's length, and
+    returns ``(tokens, source)`` — at most ``k`` proposed ids and a
+    short label for the telemetry scoreboard (``None`` when nothing
+    was drafted).  Drafting must be a pure function of the context:
+    the fleet failover contract replays ``prompt + emitted`` on
+    another replica and the continuation stays token-identical only if
+    the drafts (and therefore the verify-step boundaries) reproduce."""
+
+    k: int
+
+    def draft(self, context: Sequence[int], prompt_len: int
+              ) -> Tuple[List[int], Optional[str]]:
+        raise NotImplementedError
+
+
+class NGramDraftSource(DraftSource):
+    """Self-speculation: n-gram / prompt-lookup drafting.
+
+    Try n-gram sizes from ``max_ngram`` down to ``min_ngram``: take the
+    context's last ``n`` tokens, find the MOST RECENT earlier position
+    where the same n-gram occurs, and propose the (up to) ``k`` tokens
+    that followed it.  The hit is labelled ``"prompt_lookup"`` when the
+    proposed continuation starts inside the prompt (output copying
+    input — the summarize/extract win) and ``"ngram"`` when it starts
+    in the generated region (the model repeating itself).  No match at
+    any size returns an empty draft — the verify step then degrades to
+    a plain one-token decode step for that slot.
+
+    Longer n-grams are tried first because a longer match is a more
+    specific (higher-acceptance) context; ``min_ngram=1`` makes even a
+    single repeated token draftable, which is what keeps repetitive
+    traces above one accepted token per step."""
+
+    name = "ngram"
+
+    def __init__(self, k: int, *, max_ngram: int = 3,
+                 min_ngram: int = 1):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if not (1 <= min_ngram <= max_ngram):
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{min_ngram}..{max_ngram}")
+        self.k = int(k)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def draft(self, context: Sequence[int], prompt_len: int
+              ) -> Tuple[List[int], Optional[str]]:
+        ctx = np.asarray(context, np.int32)
+        L = int(ctx.size)
+        # a match needs the n-gram tail, an earlier occurrence, and at
+        # least one continuation token: L >= n + 2 overall
+        hi = min(self.max_ngram, L - 2)
+        for n in range(hi, self.min_ngram - 1, -1):
+            tail = ctx[L - n:]
+            # candidate starts j in [0, L-1-n]: ctx[j:j+n] == tail with
+            # ctx[j+n] existing and not the tail's own start
+            windows = np.lib.stride_tricks.sliding_window_view(
+                ctx[:L - 1], n)
+            hits = np.nonzero((windows == tail[None]).all(axis=1))[0]
+            if hits.size == 0:
+                continue
+            j = int(hits[-1])                   # most recent occurrence
+            cont = ctx[j + n:j + n + self.k]
+            source = ("prompt_lookup" if j + n < prompt_len
+                      else "ngram")
+            return [int(t) for t in cont], source
+        return [], None
+
+
+class NullDraftSource(DraftSource):
+    """Never drafts.  The speculative step then commits exactly one
+    token per weight stream — the never-drafted reference the rollback
+    bit-identity tests compare a drafted run's pools against."""
+
+    name = "null"
+
+    def __init__(self, k: int = 1):
+        self.k = int(k)
+
+    def draft(self, context: Sequence[int], prompt_len: int
+              ) -> Tuple[List[int], Optional[str]]:
+        return [], None
+
+
+class ModelDraftSource(DraftSource):
+    """The ``draft_model=`` seam: draft with a SMALL shared-tokenizer
+    model, verify with the big one.  The serving plumbing (fixed-k
+    slot schedule, verify step, acceptance rule, multi-token harvest)
+    is draft-source-agnostic, so when a distilled draft checkpoint
+    exists it plugs in here — until then this raises at construction
+    so nobody silently serves with an unimplemented draft."""
+
+    name = "draft_model"
+
+    def __init__(self, draft_model, k: int):
+        raise NotImplementedError(
+            "draft-model speculation is a stub: self-speculation "
+            "(NGramDraftSource) is the shipping draft source.  A "
+            "shared-tokenizer draft model needs its own decode carry "
+            "and a per-slot draft loop before the verify step — the "
+            "acceptance rule and serving schedule here already "
+            "support it (docs/serving.md, 'Speculative decoding')")

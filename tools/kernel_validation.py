@@ -1219,6 +1219,106 @@ def validate_fmha_decode(smoke=False):
             })
             print(json.dumps(results[-1]))
 
+    # ---- speculative-verify cells: s_q in {4, 8, 16} — the
+    # draft-and-verify step scores k drafts + 1 bonus row per slot in
+    # one pass, per-row causal at lengths - sq + i exactly like the
+    # chunk cells above but at the SMALL s_q the k-selection trade
+    # lives at (acceptance saturates long before chunk sizes).  Ragged
+    # lengths and shuffled page tables as everywhere; same parity gate
+    # (1) and never-lose-to-XLA gate (2) — the TPU capture must cover
+    # the verify shape family before anyone trusts a speculative
+    # speedup measured through it.
+    vsqs = [8] if smoke else [4, 8, 16]
+    vkvs = ["bfloat16"] if smoke else ["bfloat16", "int8"]
+    for sq in vsqs:
+        b, cache = 8, (512 if smoke else 2048)
+        npp = cache // ps
+        pool_pages = 1 + b * npp
+        key = jax.random.PRNGKey(1000 + sq)
+        k0, k1, k2, k3 = jax.random.split(key, 4)
+        km = jax.random.normal(k0, (pool_pages, h, ps, d), jnp.bfloat16)
+        vm = jax.random.normal(k1, (pool_pages, h, ps, d), jnp.bfloat16)
+        q = jax.random.normal(k2, (b, h, sq, d), jnp.bfloat16)
+        perm = jax.random.permutation(
+            k3, jnp.arange(1, pool_pages, dtype=jnp.int32))
+        page_table = perm[: b * npp].reshape(b, npp)
+        # ragged: slots mid-generation sit at arbitrary offsets inside
+        # their last page (lengths count the verify rows themselves,
+        # current token + k drafts, all >= sq)
+        lengths = jnp.where(
+            jnp.arange(b) % 2 == 0, cache, cache - ps // 2 - 1
+        ).astype(jnp.int32)
+        for kv in vkvs:
+            if kv == "int8":
+                def q8v(pages):
+                    vals, scales = quantize_rows(
+                        pages.reshape(-1, d).astype(jnp.float32),
+                        kv_block)
+                    return (vals.reshape(pages.shape),
+                            scales.reshape(*pages.shape[:-1], -1))
+
+                kp, ks = q8v(km)
+                vp, vs = q8v(vm)
+            else:
+                kp, vp = km, vm
+                ks = vs = None
+            kwargs = dict(k_scales=ks, v_scales=vs, kv_block=kv_block)
+
+            def fwd_t(impl):
+                return jax.jit(
+                    lambda q, kp, vp: jnp.sum(fmha_decode(
+                        q, kp, vp, page_table, lengths,
+                        implementation=impl, **kwargs,
+                    ).astype(jnp.float32)))
+
+            with jax.default_matmul_precision("highest"):
+                if kv == "int8":
+                    from apex_tpu.ops.attention_decode import (
+                        _dequant_pages,
+                    )
+                    kr = _dequant_pages(kp, ks, kv_block)
+                    vr = _dequant_pages(vp, vs, kv_block)
+                else:
+                    kr, vr = (kp.astype(jnp.float32),
+                              vp.astype(jnp.float32))
+                ref = jax.jit(
+                    lambda q, kr, vr: paged_attention_reference(
+                        q, kr, vr, page_table, lengths))(
+                    q.astype(jnp.float32), kr, vr)
+            out_p = jax.device_get(jax.jit(
+                lambda q, kp, vp: fmha_decode(
+                    q, kp, vp, page_table, lengths,
+                    implementation="pallas", **kwargs))(q, kp, vp))
+            out_x = jax.device_get(jax.jit(
+                lambda q, kp, vp: fmha_decode(
+                    q, kp, vp, page_table, lengths,
+                    implementation="xla", **kwargs))(q, kp, vp))
+            iters = 10 if smoke else 50
+            p_ms = _time(fwd_t("pallas"), q, kp, vp, iters=iters)
+            x_ms = _time(fwd_t("xla"), q, kp, vp, iters=iters)
+            kv_bytes = 2 * b * npp * ps * h * d * \
+                jnp.dtype(kp.dtype).itemsize
+            results.append({
+                "kernel": "fmha_decode",
+                "shape": [b, h, sq, d],
+                "cache_len": cache,
+                "page_size": ps,
+                "dtype": kv,
+                "causal": True,
+                "auto_impl": "pallas",
+                "speculative_verify": True,
+                "fwd": {
+                    "pallas_ms": round(p_ms, 3),
+                    "xla_ms": round(x_ms, 3),
+                    "speedup": round(x_ms / p_ms, 2),
+                    "decode_gbs": round(
+                        kv_bytes / (p_ms * 1e-3) / 1e9, 1),
+                    "max_err_vs_fp32": _max_err(out_p, ref),
+                    "xla_err_vs_fp32": _max_err(out_x, ref),
+                },
+            })
+            print(json.dumps(results[-1]))
+
     # ---- end-to-end greedy-generation gate: the paged serving stack
     # must reproduce the unpaged full-recompute reference exactly
     import numpy as np
@@ -1254,15 +1354,26 @@ def validate_fmha_decode(smoke=False):
                            page_size=16, max_seqs=2, harvest_every=4,
                            prefill_chunk=8, prefix_cache=True)
     match_c = all(list(ref_toks[i]) == got_c[i] for i in range(bgen))
+    # speculative decoding must ALSO land on the reference tokens: the
+    # verify step's k+1-row pass and the rollback-by-length-truncation
+    # must be invisible in the output (the n-gram draft source makes
+    # acceptance patterns data-dependent, so this exercises variable
+    # multi-token advances on hardware)
+    got_s = model.generate(params, prompts, plens, new, mesh=mesh,
+                           page_size=16, max_seqs=2, harvest_every=4,
+                           speculate_k=4)
+    match_s = all(list(ref_toks[i]) == got_s[i] for i in range(bgen))
     results.append({
         "kernel": "decode_generation",
         "shape": [bgen, sp, new],
         "dtype": "bfloat16",
         "greedy_match": bool(match),
         "chunked_greedy_match": bool(match_c),
+        "speculative_greedy_match": bool(match_s),
         "note": "paged serving stack (continuous batching, 2 slots / "
                 "4 requests; monolithic AND chunked+prefix-cache "
-                "prefill) vs naive full-recompute greedy reference",
+                "prefill AND speculative k=4) vs naive full-recompute "
+                "greedy reference",
     })
     print(json.dumps(results[-1]))
     return results
@@ -1408,6 +1519,12 @@ def main():
                 not e.get("chunked_greedy_match", True):
             bad.append((e, "CHUNKED-prefill greedy generation diverged "
                            "from the full-recompute reference"))
+        if e.get("kernel") == "decode_generation" and \
+                not e.get("speculative_greedy_match", True):
+            bad.append((e, "SPECULATIVE greedy generation diverged "
+                           "from the full-recompute reference — the "
+                           "verify step / acceptance rule changed "
+                           "semantics"))
     if True in flag and False in flag:
         # same shipped config on both sides (best-of-sweep could pick
         # different blocks per causality and fake a skip win)

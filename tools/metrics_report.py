@@ -163,6 +163,49 @@ def summarize_serving(records: List[dict]) -> Optional[Dict[str, Any]]:
             "pages_copied": sum(
                 1 for r in hits if r.get("copied")),
         }
+    spec = [r for r in records
+            if r.get("kind") == "event"
+            and r.get("event") == "spec_accept"]
+    if spec:
+        # the speculation scoreboard: one spec_accept event per verify
+        # step (emitted from the commit resolve the speculative window
+        # already performs — no extra host syncs behind it)
+        drafted = sum(int(r.get("drafted", 0)) for r in spec)
+        accepted = sum(int(r.get("accepted", 0)) for r in spec)
+        committed = sum(int(r.get("committed", 0)) for r in spec)
+        slot_steps = sum(len(r.get("commits", [])) for r in spec)
+        hist: Dict[str, int] = {}
+        for r in spec:
+            for nc in r.get("commits", []):
+                hist[str(int(nc))] = hist.get(str(int(nc)), 0) + 1
+        by_source: Dict[str, Dict[str, Any]] = {}
+        for r in spec:
+            for src, rec in (r.get("by_source") or {}).items():
+                tot = by_source.setdefault(
+                    src, {"drafted": 0, "accepted": 0})
+                tot["drafted"] += int(rec.get("drafted", 0))
+                tot["accepted"] += int(rec.get("accepted", 0))
+        for src, tot in by_source.items():
+            if tot["drafted"]:
+                tot["hit_rate"] = round(
+                    tot["accepted"] / tot["drafted"], 4)
+        out["speculation"] = {
+            "verify_steps": len(spec),
+            "drafted": drafted,
+            "accepted": accepted,
+            "committed": committed,
+            # tokens committed per slot per verify step (1 = the plain
+            # decode rate; k+1 = a fully accepted draft + bonus)
+            "accepted_per_step_hist": hist,
+            "committed_per_slot_step": (
+                round(committed / slot_steps, 4) if slot_steps else None),
+            # drafted rows the verify pass computed but threw away —
+            # the price of a miss, what the k-selection trade bounds
+            "wasted_verify_fraction": (
+                round((drafted - accepted) / drafted, 4)
+                if drafted else None),
+            "by_source": by_source,
+        }
     if done:
         reasons: Dict[str, int] = {}
         ttfts = []
@@ -510,6 +553,31 @@ def format_report(summary: Dict[str, Any]) -> str:
                 f"{px['pages_shared']} pages shared, "
                 f"{px['prefill_tokens_skipped']} prefill tokens "
                 f"skipped, {px['pages_copied']} CoW copies")
+        if "speculation" in sv:
+            sp = sv["speculation"]
+            row = (f"  speculation: {sp['committed']} tokens in "
+                   f"{sp['verify_steps']} verify steps")
+            if sp.get("committed_per_slot_step") is not None:
+                row += (f" ({sp['committed_per_slot_step']:.2f} "
+                        "tokens/slot-step)")
+            if sp.get("wasted_verify_fraction") is not None:
+                row += (f", wasted-verify "
+                        f"{sp['wasted_verify_fraction']:.0%}")
+            lines.append(row)
+            if sp.get("accepted_per_step_hist"):
+                hist = "  ".join(
+                    f"{k}:{v}" for k, v in sorted(
+                        sp["accepted_per_step_hist"].items(),
+                        key=lambda kv: int(kv[0])))
+                lines.append(
+                    f"    committed-per-step histogram: {hist}")
+            for src, tot in sorted(
+                    (sp.get("by_source") or {}).items()):
+                row = (f"    [{src}] drafted {tot['drafted']}  "
+                       f"accepted {tot['accepted']}")
+                if "hit_rate" in tot:
+                    row += f"  hit rate {tot['hit_rate']:.0%}"
+                lines.append(row)
     fl = summary.get("fleet")
     if fl:
         lines.append("fleet summary:")
