@@ -455,17 +455,30 @@ class _DistributedOptimizer:
             state["comm"] = new_res
         return params, state
 
-    def unshard_params(self, global_shards) -> Any:
+    def unshard_params(self, global_shards, transform=None) -> Any:
         """Host-side: a ZeRO-3 checkpoint's flat shard buffer (the
         ``device_get`` of the placed shard array) → the full replicated
         param pytree — resume into a replicated-eval setup with this.
         Bit-identical to a full-width :meth:`gather_params`; under
         int8 gathers (``ici_legs``) the device view is the lossy wire
         format and this rebuild is the exact fp32 master, i.e. at
-        least as accurate."""
+        least as accurate.
+
+        ``transform`` is the checkpoint-load conversion seam: called
+        ONCE on the rebuilt tree before anything is placed on device —
+        e.g. ``lambda p: quantize_gpt_weights(p, "int8")`` to serve a
+        trained checkpoint from a quantized weight pool without the
+        full-width tree ever reaching HBM.  Quantization is a pure
+        function of the weight bits and the rebuild is exact, so
+        ``unshard → quantize`` is bit-identical to quantizing the
+        replicated weights directly (pinned in
+        tests/test_weight_quant.py)."""
         import numpy as _np
 
-        return self.layout.unshard(_np.asarray(global_shards))
+        params = self.layout.unshard(_np.asarray(global_shards))
+        if transform is not None:
+            params = transform(params)
+        return params
 
     def init(self, params: Any) -> dict:
         """Build the sharded state — call inside shard_map with

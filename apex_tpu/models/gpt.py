@@ -56,7 +56,8 @@ from apex_tpu.transformer.tensor_parallel.random import (
 )
 from apex_tpu._compat import axis_size as _axis_size
 
-__all__ = ["GPTConfig", "GPTModel", "GPTDecodeFns"]
+__all__ = ["GPTConfig", "GPTModel", "GPTDecodeFns",
+           "quantize_gpt_weights", "QUANTIZED_WEIGHT_LEAVES"]
 
 
 @dataclasses.dataclass
@@ -88,6 +89,80 @@ class GPTDecodeFns:
     spec: Any = None
     spec_jit: Any = None
     speculate_k: Any = None
+    #: the active weight width of the pool every step streams —
+    #: "float32"/"bf16" for plain weights, "int8"/"int4" for quantized
+    #: pools (``decode_fns(weight_dtype=...)``).  Mirrored as
+    #: ``decode.weight_dtype`` so the batcher's telemetry can report
+    #: the width without seeing the params.
+    weight_dtype: Any = None
+    #: bytes of model parameters ONE decode step streams from HBM (the
+    #: whole pool: projections at their quantized width + scales,
+    #: embedding/norms at full width).  Mirrored as
+    #: ``decode.weight_stream_bytes``; with the span durations this is
+    #: the serving weight-stream GB/s headline
+    #: (tools/metrics_report.py).
+    weight_stream_bytes: Any = None
+
+
+#: the projection weight leaves :func:`quantize_gpt_weights` converts —
+#: the wide matrices decode streams every token.  Embedding (tied LM
+#: head), position table, norms and biases stay full precision: they
+#: are a rounding error of the stream and the head's logit quality is
+#: disproportionately sensitive.
+QUANTIZED_WEIGHT_LEAVES = ("qkv", "attn_proj", "fc1", "fc_gate", "fc2")
+
+
+def quantize_gpt_weights(
+    params: Dict[str, Any],
+    weight_dtype: str,
+    block_size: int = 128,
+) -> Dict[str, Any]:
+    """Convert a GPT param tree's projection weights to a quantized
+    weight pool — ONCE, at checkpoint load.
+
+    Each leaf in :data:`QUANTIZED_WEIGHT_LEAVES` swaps its ``"weight"``
+    array ``(L, k, n)`` for ``{"q8": int8, "scales": fp32}``
+    (``weight_dtype="int8"``) or ``{"q4": packed int8, "scales": fp32}``
+    (``"int4"`` — two nibbles per byte, :func:`pack_int4` halves
+    layout), block-quantized along the OUTPUT features with
+    ``block_size``-wide fp32 scales — the same
+    :func:`~apex_tpu.ops.quantization.quantize_rows` discipline the
+    wire collectives use.  The dict KEY is the static width marker:
+    the decode forward dispatches on pytree structure
+    (:meth:`GPTModel._apply_linear`), so one set of step functions
+    serves any width with zero recompiles ACROSS widths only at build
+    time — each width is its own (fixed-shape) compilation.
+
+    Quantization is deterministic (pure function of the weight bits),
+    so quantizing an ``unshard()``-rebuilt ZeRO-3 checkpoint is
+    bit-identical to quantizing the replicated weights directly
+    (pinned in tests/test_weight_quant.py), and ONE pool can be built
+    host-side and shared read-only by every fleet replica."""
+    from apex_tpu.ops.dequant_matmul import quantize_weight
+
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(
+            f"weight_dtype must be 'int8' or 'int4', got "
+            f"{weight_dtype!r}")
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in QUANTIZED_WEIGHT_LEAVES:
+        if name not in layers:
+            continue
+        leaf = dict(layers[name])
+        w = leaf.pop("weight")
+        L, k, n = w.shape
+        # rows are independent: the stacked (L, k, n) quantizes as
+        # L*k rows of n, bit-identical to a per-layer loop
+        wq = quantize_weight(
+            jnp.reshape(w, (L * k, n)), weight_dtype, block_size,
+            leaf=f"layers/{name}.weight")
+        qkey = "q8" if "q8" in wq else "q4"
+        leaf[qkey] = jnp.reshape(wq[qkey], (L, k, -1))
+        leaf["scales"] = jnp.reshape(wq["scales"], (L, k, -1))
+        layers[name] = leaf
+    out["layers"] = layers
+    return out
 
 
 @dataclasses.dataclass
@@ -400,6 +475,69 @@ class GPTModel:
         return specs
 
     # ------------------------------------------------------------- forward
+    @staticmethod
+    def _apply_linear(mod, p: Dict[str, Any], y: jnp.ndarray):
+        """ONE projection dot, dispatched on the param leaf's
+        STRUCTURE.  A plain ``{"weight", ...}`` leaf runs the
+        tensor-parallel module unchanged (training and full-width
+        serving).  A quantized-pool leaf (``{"q8"/"q4", "scales", ...}``
+        — :func:`quantize_gpt_weights`) streams the int8/int4 weights
+        through :func:`~apex_tpu.ops.dequant_matmul.dequant_matmul`,
+        which dequantizes inside the matmul tiles so the wide matrix
+        never materializes in HBM.  Structure is static at trace time,
+        so the width costs no dynamic flag threading and each width
+        compiles to its own fixed-shape program.  The quantized branch
+        skips the tp collectives: quantized pools exist only on the
+        serving path, which :meth:`decode_fns` pins to tp=pp=1."""
+        if "weight" in p:
+            return mod.apply(p, y)
+        from apex_tpu.ops.dequant_matmul import (
+            dequant_matmul, weight_pool_dtype,
+        )
+
+        out = dequant_matmul(
+            y, p["q8"] if "q8" in p else p["q4"], p["scales"],
+            weight_dtype=weight_pool_dtype(p))
+        if "bias" in p:
+            out = out + p["bias"].astype(out.dtype)
+        return out
+
+    def _weight_pool_dtype(self, params: Dict[str, Any]) -> str:
+        """The active weight width a param tree's STRUCTURE implies:
+        ``"int8"``/``"int4"`` when the projection leaves are quantized
+        pools, the storage dtype name (``"float32"``/``"bf16"``)
+        otherwise — the ground truth the ``weight_dtype=`` declaration
+        is validated against."""
+        layers = params["layers"]
+        for name in QUANTIZED_WEIGHT_LEAVES:
+            leaf = layers.get(name)
+            if leaf is None:
+                continue
+            if "q8" in leaf:
+                return "int8"
+            if "q4" in leaf:
+                return "int4"
+            d = leaf["weight"].dtype
+            return "bf16" if d == jnp.bfloat16 else str(d)
+        return "float32"
+
+    def _check_weight_dtype(self, params: Dict[str, Any],
+                            weight_dtype: Optional[str]):
+        """Declared-width validation for the serving steps: the params
+        structure IS the active width; a step invoked with a
+        ``weight_dtype=`` claim that disagrees raises at trace time
+        instead of silently serving the wrong numerics contract."""
+        if weight_dtype is None:
+            return
+        want = {"fp32": "float32", "bfloat16": "bf16"}.get(
+            weight_dtype, weight_dtype)
+        have = self._weight_pool_dtype(params)
+        if want != have:
+            raise ValueError(
+                f"weight_dtype={weight_dtype!r} declared but the "
+                f"params carry {have} weights — quantize with "
+                f"quantize_gpt_weights (or drop the declaration)")
+
     def _qkv_heads(self, lp: Dict[str, Any], y: jnp.ndarray):
         """(b, s, h) normed activations -> (q, k, v), each
         ``(b, heads_local, s, head_dim)``.  The output dim of the fused
@@ -415,7 +553,7 @@ class GPTModel:
         world = _axis_size(self.axis_name)
         heads_local = c.num_attention_heads // world
         b, s, _ = y.shape
-        qkv = self.qkv.apply(lp["qkv"], y)  # (b, s, 3h/tp)
+        qkv = self._apply_linear(self.qkv, lp["qkv"], y)  # (b, s, 3h/tp)
         qkv = qkv.reshape(b, s, heads_local, 3, c.head_dim)
         return tuple(
             jnp.moveaxis(qkv[:, :, :, i], 2, 1) for i in range(3)
@@ -430,12 +568,13 @@ class GPTModel:
         reason as :meth:`_qkv_heads`: the serving path must not be able
         to drift from the math the model trained with."""
         if self.fc_gate is not None:
-            y = (jax.nn.silu(self.fc_gate.apply(lp["fc_gate"], y))
-                 * self.fc1.apply(lp["fc1"], y))
+            y = (jax.nn.silu(self._apply_linear(
+                    self.fc_gate, lp["fc_gate"], y))
+                 * self._apply_linear(self.fc1, lp["fc1"], y))
         else:
-            y = self.fc1.apply(lp["fc1"], y)
+            y = self._apply_linear(self.fc1, lp["fc1"], y)
             y = jax.nn.gelu(y, approximate=True)
-        return self.fc2.apply(lp["fc2"], y)
+        return self._apply_linear(self.fc2, lp["fc2"], y)
 
     def _layer(self, lp: Dict[str, Any], x: jnp.ndarray, key,
                rope=None) -> jnp.ndarray:
@@ -494,7 +633,8 @@ class GPTModel:
                 q, k, v, causal=True, implementation=c.attention_impl
             )
         attn = jnp.moveaxis(attn, 1, 2).reshape(b, s, heads_local * c.head_dim)
-        out = self.attn_proj.apply(lp["attn_proj"], attn)  # psum inside
+        out = self._apply_linear(
+            self.attn_proj, lp["attn_proj"], attn)  # psum inside
         if c.hidden_dropout > 0.0 and key is not None:
             # replicated activations ⇒ mask must agree across tp ranks:
             # fold in only the dp rank (reference keeps this on the
@@ -713,6 +853,7 @@ class GPTModel:
         *,
         quantized: bool = False,
         kv_block: int = 128,
+        weight_dtype: Optional[str] = None,
     ):
         """ONE fixed-size prompt-ingestion chunk for a single serving
         slot — the Sarathi-style alternative to :meth:`prefill_forward`
@@ -746,6 +887,7 @@ class GPTModel:
         c = self.config
         if self.moe is not None:
             raise NotImplementedError("MoE decode is not supported")
+        self._check_weight_dtype(params, weight_dtype)
         C = tokens.shape[-1]
         tokens = tokens.reshape(1, C)
         page_size = pools["k"].shape[3]
@@ -806,7 +948,8 @@ class GPTModel:
                 v_scales=pool_l.get("v_scales"), kv_block=kv_block,
                 rope=rope_cs, implementation=decode_impl)
             attn = jnp.moveaxis(attn, 1, 2).reshape(1, C, -1)
-            out = self.attn_proj.apply(lp["attn_proj"], attn)
+            out = self._apply_linear(self.attn_proj, lp["attn_proj"],
+                                     attn)
             x = residual + out.astype(residual.dtype)
             residual = x
             y = self._norm(lp["ln2"], x).astype(c.compute_dtype)
@@ -832,6 +975,7 @@ class GPTModel:
         *,
         quantized: bool = False,
         kv_block: int = 128,
+        weight_dtype: Optional[str] = None,
     ):
         """ONE fused decode step for a fixed batch of serving slots —
         call inside shard_map.  ``tokens (S,)`` are the current tokens
@@ -850,6 +994,7 @@ class GPTModel:
         c = self.config
         if self.moe is not None:
             raise NotImplementedError("MoE decode is not supported")
+        self._check_weight_dtype(params, weight_dtype)
         S = tokens.shape[0]
         page_size = pools["k"].shape[3]
         positions = positions.astype(jnp.int32)
@@ -903,7 +1048,8 @@ class GPTModel:
                 v_scales=pool_l.get("v_scales"), kv_block=kv_block,
                 rope=rope_cs, implementation=decode_impl)
             attn = jnp.moveaxis(attn, 1, 2).reshape(S, 1, -1)
-            out = self.attn_proj.apply(lp["attn_proj"], attn)
+            out = self._apply_linear(self.attn_proj, lp["attn_proj"],
+                                     attn)
             x = residual + out.astype(residual.dtype)
             residual = x
             y = self._norm(lp["ln2"], x).astype(c.compute_dtype)
@@ -927,6 +1073,7 @@ class GPTModel:
         *,
         quantized: bool = False,
         kv_block: int = 128,
+        weight_dtype: Optional[str] = None,
     ):
         """ONE speculative verify step: :meth:`decode_step` widened to
         ``R = k + 1`` token rows per slot, ONE weight stream for all of
@@ -961,6 +1108,7 @@ class GPTModel:
         c = self.config
         if self.moe is not None:
             raise NotImplementedError("MoE decode is not supported")
+        self._check_weight_dtype(params, weight_dtype)
         S, R = tokens.shape
         page_size = pools["k"].shape[3]
         lengths = lengths.astype(jnp.int32)
@@ -1019,7 +1167,8 @@ class GPTModel:
                 v_scales=pool_l.get("v_scales"), kv_block=kv_block,
                 rope=rope_cs, implementation=decode_impl)
             attn = jnp.moveaxis(attn, 1, 2).reshape(S, R, -1)
-            out = self.attn_proj.apply(lp["attn_proj"], attn)
+            out = self._apply_linear(self.attn_proj, lp["attn_proj"],
+                                     attn)
             x = residual + out.astype(residual.dtype)
             residual = x
             y = self._norm(lp["ln2"], x).astype(c.compute_dtype)
@@ -1045,6 +1194,8 @@ class GPTModel:
         prefill_chunk: Optional[int] = None,
         speculate_k: Optional[int] = None,
         draft_model: Optional[Any] = None,
+        weight_dtype: Optional[str] = None,
+        weight_block: int = 128,
     ):
         """Build the jitted serving step functions the
         continuous-batching driver
@@ -1076,6 +1227,18 @@ class GPTModel:
         slot's current context length, so a seeded request's sampled
         stream is reproducible regardless of admission order or slot
         assignment (tests/test_serving.py pins it).
+
+        ``weight_dtype`` sets the width of the weight pool every step
+        streams: ``"int8"``/``"int4"`` convert the projection weights
+        ONCE here via :func:`quantize_gpt_weights` (block size
+        ``weight_block``) and the steps dequantize inside the matmul
+        tiles; ``"bf16"`` casts the same leaves; ``None`` serves the
+        params as given — INCLUDING an already-quantized pool, which is
+        how fleet replicas share one read-only pool (quantize once,
+        call ``decode_fns`` per replica with the shared tree).  The
+        active width and the per-step weight-stream bytes are stamped
+        on the returned struct and on ``decode`` for the batcher's
+        telemetry.
 
         Serving runs dp-replicated on the mesh; tensor/pipeline/
         context-parallel decode is not implemented (the cache pools
@@ -1118,7 +1281,44 @@ class GPTModel:
                 f"cache holds up to {cfg.max_len} positions but the "
                 f"learned table stops at {c.max_position_embeddings}")
 
+        if weight_dtype is not None and weight_dtype not in (
+                "bf16", "int8", "int4"):
+            raise ValueError(
+                f"weight_dtype must be None, 'bf16', 'int8' or "
+                f"'int4', got {weight_dtype!r}")
+        wd_in = self._weight_pool_dtype(params)
+        if weight_dtype in ("int8", "int4"):
+            if wd_in in ("int8", "int4"):
+                if wd_in != weight_dtype:
+                    raise ValueError(
+                        f"weight_dtype={weight_dtype!r} requested but "
+                        f"the params already carry a {wd_in} pool")
+            else:
+                # the ONE conversion — at build (= checkpoint-load)
+                # time, never per step
+                params = quantize_gpt_weights(
+                    params, weight_dtype, weight_block)
+        elif weight_dtype == "bf16" and wd_in == "float32":
+            layers = dict(params["layers"])
+            for name in QUANTIZED_WEIGHT_LEAVES:
+                if name in layers:
+                    leaf = dict(layers[name])
+                    leaf["weight"] = leaf["weight"].astype(jnp.bfloat16)
+                    layers[name] = leaf
+            params = {**params, "layers": layers}
+        wd_active = self._weight_pool_dtype(params)
+
         specs = self.param_specs()
+        if wd_active in ("int8", "int4"):
+            # the spec tree must mirror the quantized pytree structure;
+            # serving is pinned to tp=pp=1 above, so replicated specs
+            # are exact for the new leaves
+            lspecs = dict(specs["layers"])
+            for name in QUANTIZED_WEIGHT_LEAVES:
+                if name in lspecs:
+                    lspecs[name] = jax.tree.map(
+                        lambda _: P(), params["layers"][name])
+            specs["layers"] = lspecs
         pool_tmpl = jax.eval_shape(lambda: init_pools(cfg))
         pool_specs = jax.tree.map(lambda _: P(), pool_tmpl)
         rep = lambda tree: jax.tree.map(lambda _: P(), tree)
@@ -1150,7 +1350,8 @@ class GPTModel:
                    page_row, key):
             logits, pools = self.prefill_chunk(
                 params, toks, start, plen, write_from, page_row,
-                pools, quantized=cfg.quantized, kv_block=cfg.kv_block)
+                pools, quantized=cfg.quantized, kv_block=cfg.kv_block,
+                weight_dtype=wd_active)
             tok = sample(logits[None], jax.random.fold_in(key, plen),
                          temperature, top_k, top_p)[0]
             return pools, tok, logits
@@ -1160,7 +1361,7 @@ class GPTModel:
             logits, pools = self.decode_step(
                 params, carry["tokens"], carry["lengths"], active,
                 page_table, pools, quantized=cfg.quantized,
-                kv_block=cfg.kv_block)
+                kv_block=cfg.kv_block, weight_dtype=wd_active)
             if temperature == 0.0:
                 sampled = sample(logits, None, 0.0)
             else:
@@ -1205,7 +1406,8 @@ class GPTModel:
             valid = jrow <= draft_len[:, None]
             logits, pools = self.verify_step(
                 params, rows, lengths, active, valid, page_table,
-                pools, quantized=cfg.quantized, kv_block=cfg.kv_block)
+                pools, quantized=cfg.quantized, kv_block=cfg.kv_block,
+                weight_dtype=wd_active)
             # row j's draw sits after lengths + 1 + j context tokens —
             # fold exactly what the plain one-token loop would fold at
             # that position, so the committed stream is key-schedule
@@ -1270,6 +1472,12 @@ class GPTModel:
         # the batcher only sees the callables; stamp the freeze id so
         # it can reject a host truncation id the device disagrees with
         decode.eos_id = eos_id
+        # ONE decode step streams the whole pool: projections at the
+        # active width (+ fp32 scales), embedding/norms full width —
+        # the numerator of the serving weight-stream GB/s headline
+        wbytes = int(sum(x.nbytes for x in jax.tree.leaves(params)))
+        decode.weight_dtype = wd_active
+        decode.weight_stream_bytes = wbytes
         chunk = cj = None
         if prefill_chunk is not None:
             from apex_tpu.ops.attention_decode import (
@@ -1362,6 +1570,8 @@ class GPTModel:
             spec_jit=sj,
             speculate_k=(None if speculate_k is None
                          else int(speculate_k)),
+            weight_dtype=wd_active,
+            weight_stream_bytes=wbytes,
         )
 
     def generate(
@@ -1388,13 +1598,18 @@ class GPTModel:
         prefix_cache: bool = False,
         speculate_k: Optional[int] = None,
         draft_source: Optional[Any] = None,
+        weight_dtype: Optional[str] = None,
+        weight_block: int = 128,
     ):
         """Generate from ``prompts (b, s)`` (right-padded; real lengths
         in ``prompt_lengths``) through the full serving stack — paged
         KV cache, fused decode kernel, on-device sampling, continuous
         batching.  ``max_seqs`` (default ``b``) bounds concurrent
         slots, so ``b > max_seqs`` exercises real admit/retire churn.
-        ``kv_dtype=jnp.int8`` stores the cache quantized.
+        ``kv_dtype=jnp.int8`` stores the cache quantized;
+        ``weight_dtype="bf16"/"int8"/"int4"`` additionally serves from
+        a reduced-width weight pool (in-kernel dequant,
+        docs/serving.md).
         ``prefill_chunk`` switches prompt ingestion to the stall-free
         chunked scheduler (docs/serving.md) and ``prefix_cache``
         additionally shares identical prompt prefixes across requests.
@@ -1435,7 +1650,8 @@ class GPTModel:
             params, mesh, ccfg, max_prompt_len=s,
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_id=eos_id, prefill_chunk=prefill_chunk,
-            speculate_k=speculate_k)
+            speculate_k=speculate_k, weight_dtype=weight_dtype,
+            weight_block=weight_block)
         batcher = ContinuousBatcher(
             fns.prefill, fns.decode, PagedKVCache(ccfg),
             init_pools(ccfg), max_prompt_len=s,

@@ -35,12 +35,18 @@ from apex_tpu.ops.quantization import (  # noqa: F401
     quantize_blockwise,
     quantized_psum,
 )
+from apex_tpu.ops.dequant_matmul import (  # noqa: F401
+    dequant_matmul,
+    quantize_weight,
+)
 
 __all__ = [
     "CompressionConfig",
     "dequantize_blockwise",
     "quantize_blockwise",
     "quantized_psum",
+    "dequant_matmul",
+    "quantize_weight",
     "fmha_mid",
     "fmha_short",
     "fused_layer_norm",

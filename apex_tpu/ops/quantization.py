@@ -64,6 +64,10 @@ __all__ = [
     "dequantize_blockwise",
     "quantize_rows",
     "dequantize_rows",
+    "pack_int4",
+    "unpack_int4",
+    "quantize_rows_int4",
+    "dequantize_rows_int4",
     "comm_residual_sizes",
     "hierarchical_residual_sizes",
     "zero3_residual_sizes",
@@ -74,6 +78,7 @@ __all__ = [
 ]
 
 _INT8_MAX = 127.0
+_INT4_MAX = 7.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -199,11 +204,32 @@ def dequantize_blockwise(
     )
 
 
+def _check_row_blocks(n: int, block_size: int, leaf: Optional[str],
+                      shape) -> None:
+    """The weight-pool seam's block validation: a block size that does
+    not divide the row length would silently pad (fine for the
+    collectives, which own both ends of the wire) but corrupts a
+    weight whose kernel tiles assume whole blocks.  Callers that name
+    their ``leaf`` opt into the strict contract and get an actionable
+    error instead of a reshape traceback deep inside a jit."""
+    if leaf is None:
+        return
+    if block_size < 1 or n % block_size:
+        raise ValueError(
+            f"block_size={block_size} does not divide the row length "
+            f"of leaf {leaf!r} (shape {tuple(shape)}, rows of "
+            f"{n} elements): the in-kernel dequant tiles need whole "
+            f"blocks — pick a block_size that divides {n} (e.g. a "
+            f"power of two that divides the hidden/ffn width)")
+
+
 def quantize_rows(
     x: jnp.ndarray,
     block_size: int = 256,
     rounding: str = "nearest",
     key: Optional[jnp.ndarray] = None,
+    *,
+    leaf: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-ROW block-wise quantize of a 2-D ``(rows, n)`` array: blocks
     never straddle row boundaries, so each row can be exchanged (and
@@ -211,8 +237,15 @@ def quantize_rows(
     chunk-preserving RS/AG legs need.  Same per-block math as
     :func:`quantize_blockwise`; a single row is bit-identical to it.
     Returns ``(values int8 (rows, n), scales fp32 (rows,
-    ceil(n/block_size)))``."""
+    ceil(n/block_size)))``.
+
+    ``leaf`` (the weight-pool seam): when given, ``block_size`` MUST
+    divide ``n`` exactly — a violation raises a :class:`ValueError`
+    naming the leaf and its shape (the silent zero-padding the
+    collectives rely on would desynchronize an in-kernel dequant's
+    block tiling)."""
     rows, n = x.shape
+    _check_row_blocks(n, block_size, leaf, x.shape)
     nb = max(-(-n // block_size), 1)
     pad = nb * block_size - n
     xf = x.astype(jnp.float32)
@@ -245,6 +278,99 @@ def dequantize_rows(
     rows, n = values.shape
     expand = jnp.repeat(scales, block_size, axis=1)[:, :n]
     return (values.astype(jnp.float32) * expand).astype(dtype)
+
+
+# --------------------------------------------------------------- int4
+def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """Pack int4 values (int8 storage, each in ``[-8, 7]``) two nibbles
+    per byte: packed column ``c`` holds column ``c`` in its LOW nibble
+    and column ``c + n/2`` in its HIGH nibble (the halves layout).
+    Pairing across the row's halves — rather than adjacent columns —
+    means :func:`unpack_int4` reassembles the original column order
+    with ONE concatenation, no interleave: exactly the shape of op a
+    Pallas kernel can run on the lane dimension in VMEM.  Returns int8
+    ``(rows, n // 2)``; ``n`` must be even."""
+    rows, n = q.shape
+    if n % 2:
+        raise ValueError(
+            f"pack_int4 needs an even row length to pair nibbles, got "
+            f"shape {tuple(q.shape)}")
+    x = q.astype(jnp.int32)
+    lo = x[:, : n // 2] & 0xF
+    hi = x[:, n // 2:] & 0xF
+    p = lo | (hi << 4)
+    # two's-complement re-interpretation into int8 storage (values
+    # 128..255 map to -128..-1) — kept deterministic instead of
+    # relying on astype overflow behavior
+    return jnp.where(p < 128, p, p - 256).astype(jnp.int8)
+
+
+def unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`pack_int4`: int8 ``(rows, n/2)`` packed bytes
+    → int8 ``(rows, n)`` values in ``[-8, 7]``, exact for every
+    nibble.  Sign extension is the shift-free ``(x ^ 8) - 8`` form —
+    pure elementwise int ops, VMEM-friendly."""
+    x = packed.astype(jnp.int32) & 0xFF
+    lo = ((x & 0xF) ^ 8) - 8
+    hi = (((x >> 4) & 0xF) ^ 8) - 8
+    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.int8)
+
+
+def quantize_rows_int4(
+    x: jnp.ndarray,
+    block_size: int = 128,
+    *,
+    leaf: Optional[str] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row block-wise int4 quantize of a 2-D ``(rows, n)`` array:
+    the :func:`quantize_rows` discipline at 4-bit width (``scale =
+    max|block| / 7``, round-half-even, all-zero blocks get scale 1),
+    packed two nibbles per byte by :func:`pack_int4`.  Returns
+    ``(packed int8 (rows, n // 2), scales fp32 (rows, n /
+    block_size))``.
+
+    Constraints (checked loudly): ``block_size`` must be EVEN — an odd
+    block leaves one nibble of every block unpaired, which the
+    two-per-byte packing cannot represent; ``n`` must be a multiple of
+    ``2 * block_size`` so the packed halves layout keeps whole scale
+    blocks inside each half (the in-kernel dequant's tiling contract).
+    ``leaf`` names the owning weight in the error message."""
+    rows, n = x.shape
+    at = "" if leaf is None else f" of leaf {leaf!r}"
+    if block_size < 2 or block_size % 2:
+        raise ValueError(
+            f"int4 block_size must be even (two nibbles per byte — an "
+            f"odd block cannot pair its last nibble), got "
+            f"{block_size}{at}")
+    if n % 2:
+        raise ValueError(
+            f"int4 quantization needs an even row length{at}, got "
+            f"shape {tuple(x.shape)}")
+    if n % (2 * block_size):
+        raise ValueError(
+            f"block_size={block_size} does not tile the int4 halves "
+            f"layout{at} (shape {tuple(x.shape)}): the row length "
+            f"must be a multiple of 2 * block_size = {2 * block_size} "
+            f"so each packed half holds whole scale blocks — pick a "
+            f"smaller even block_size that divides {n // 2}")
+    nb = n // block_size
+    xb = x.astype(jnp.float32).reshape(rows, nb, block_size)
+    amax = jnp.max(jnp.abs(xb), axis=2)
+    scales = jnp.where(amax > 0.0, amax / _INT4_MAX, 1.0)
+    v = jnp.clip(xb / scales[:, :, None], -_INT4_MAX, _INT4_MAX)
+    q = jnp.clip(jnp.round(v), -_INT4_MAX, _INT4_MAX).astype(jnp.int8)
+    return pack_int4(q.reshape(rows, n)), scales
+
+
+def dequantize_rows_int4(
+    packed: jnp.ndarray,
+    scales: jnp.ndarray,
+    block_size: int = 128,
+    dtype: Any = jnp.float32,
+) -> jnp.ndarray:
+    """Inverse of :func:`quantize_rows_int4` (up to rounding error)."""
+    return dequantize_rows(unpack_int4(packed), scales, block_size,
+                           dtype)
 
 
 def comm_residual_sizes(

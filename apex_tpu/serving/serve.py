@@ -309,6 +309,14 @@ class ContinuousBatcher:
         self.prefill_fn = prefill_fn
         self.decode_fn = decode_fn
         self.chunk_fn = chunk_fn
+        #: active weight width + per-step weight-stream bytes, stamped
+        #: on the decode callable by GPTModel.decode_fns — ride on the
+        #: decode span events so tools/metrics_report.py can put
+        #: weight-stream GB/s next to decode tokens/s without ever
+        #: seeing the params
+        self.weight_dtype = getattr(decode_fn, "weight_dtype", None)
+        self.weight_stream_bytes = getattr(
+            decode_fn, "weight_stream_bytes", None)
         self.prefill_chunk = (None if prefill_chunk is None
                               else int(prefill_chunk))
         self.prefix_cache = bool(prefix_cache)
@@ -349,6 +357,18 @@ class ContinuousBatcher:
     def _event(self, kind: str, **fields) -> None:
         if self.logger is not None:
             self.logger.event(kind, **fields)
+
+    def _weight_fields(self) -> dict:
+        """The decode-span weight-stream fields (only when the decode
+        step declared its pool): the width label plus the bytes ONE
+        step streams — ``steps * weight_bytes / dur_s`` is the
+        window's weight-stream GB/s."""
+        if self.weight_dtype is None:
+            return {}
+        f = {"weight_dtype": self.weight_dtype}
+        if self.weight_stream_bytes is not None:
+            f["weight_bytes"] = int(self.weight_stream_bytes)
+        return f
 
     def _emit_gauges(self, queue_depth: int) -> None:
         """The serving load gauges (``pages_free`` / ``pages_shared`` /
@@ -753,6 +773,7 @@ class ContinuousBatcher:
             "span", span="decode", steps=steps,
             slots=len(self._meta), tokens=kept,
             dur_s=round(max(t_h - t0 - chunk_s, 0.0), 6),
+            **self._weight_fields(),
         )
         self._retire(done_h, t_h)
 
@@ -820,6 +841,7 @@ class ContinuousBatcher:
             "span", span="decode", steps=steps,
             slots=len(self._meta), tokens=kept,
             dur_s=round(max(t_h - t0 - chunk_s, 0.0), 6),
+            **self._weight_fields(),
         )
 
         self._retire(done_h, t_h)

@@ -978,9 +978,11 @@ def child_decode():
     """Decode-throughput rows: tokens/s/chip of the fused serving
     decode step (paged cache + fmha_decode + on-device sampling, the
     whole ``GPTModel.decode_step`` pipeline) at decode batch
-    {1, 8, 64, 256} for fp32 / bf16 / int8-KV caches, plus one mixed
-    prefill+decode row (a continuous-batching window that admits a
-    prompt mid-stream), the MIXED-LOAD rows: TTFT p50/p95 and
+    {1, 8, 64, 256} for fp32 / bf16 / int8-KV caches, the
+    WEIGHT-WIDTH rows: weight {bf16, int8, int4} x KV {fp32, int8} at
+    batch {1, 8, 64} with the step's weight-stream GB/s, plus one
+    mixed prefill+decode row (a continuous-batching window that admits
+    a prompt mid-stream), the MIXED-LOAD rows: TTFT p50/p95 and
     decode-stall time of long-prompt arrivals with chunked prefill on
     vs off vs on-with-shared-prefix (prefix-cache hits) at decode
     batch {8, 64, 256}, and the SPECULATIVE rows: n-gram
@@ -1018,7 +1020,12 @@ def child_decode():
     ))
     params = model.init(jax.random.PRNGKey(0))
 
-    def run_variant(kv_name, batch):
+    # weight-pool block for the quantized-weight rows: HIDDEN=256 puts
+    # the projection widths at {768, 256, 1024} — block 64 divides
+    # every one AND keeps whole blocks per int4 nibble half
+    WQ_BLOCK = 64
+
+    def run_variant(kv_name, batch, weight=None):
         kv_dtype = jnp.int8 if kv_name == "int8" else None
         dtype = (jnp.float32 if kv_name == "float32"
                  else jnp.bfloat16)
@@ -1031,7 +1038,9 @@ def child_decode():
             dtype=dtype, kv_dtype=kv_dtype,
         )
         fns = model.decode_fns(params, mesh, cfg,
-                               max_prompt_len=PROMPT)
+                               max_prompt_len=PROMPT,
+                               weight_dtype=weight,
+                               weight_block=WQ_BLOCK)
         cache = PagedKVCache(cfg)
         pools = init_pools(cfg)
         carry = init_carry(batch)
@@ -1065,14 +1074,15 @@ def child_decode():
             pools, carry = fns.decode(pools, carry, pt)
         jax.block_until_ready(carry["tokens"])
         ms = (time.perf_counter() - t0) / STEPS * 1e3
-        return ms, batch / ms * 1e3, t_pref * 1e3
+        return ms, batch / ms * 1e3, t_pref * 1e3, \
+            int(fns.weight_stream_bytes)
 
     rows = {}
     mixed_src = None
     for kv_name in ("float32", "bfloat16", "int8"):
         per_batch = {}
         for batch in BATCHES:
-            ms, tps, pref_ms = run_variant(kv_name, batch)
+            ms, tps, pref_ms, _ = run_variant(kv_name, batch)
             per_batch[str(batch)] = {
                 "ms_per_step": round(ms, 3),
                 "tokens_per_sec_per_chip": round(tps, 1),
@@ -1082,6 +1092,43 @@ def child_decode():
             log(f"decode {kv_name} b{batch}: {ms:.2f} ms/step, "
                 f"{tps:,.0f} tokens/s/chip")
         rows[kv_name] = per_batch
+
+    # ---- weight-width rows: the quantized weight pools (block-wise
+    # int8, packed int4 — dequantized inside the matmul tiles) vs the
+    # bf16 cast, each over fp32 and int8 KV caches at batch {1,8,64}.
+    # weight_stream_gbs is the decode step's weight traffic (the whole
+    # param pool per step) over the measured wall — the roofline the
+    # tentpole moves; on CPU the step is compute-bound, so the
+    # in-tile dequant arithmetic can RAISE ms/step while the weight
+    # bytes shrink — the TPU capture reads the GB/s column, not the
+    # CPU wall ratio.
+    wq = {}
+    for weight in ("bf16", "int8", "int4"):
+        per_w = {}
+        for kv_name in ("float32", "int8"):
+            per_b = {}
+            for batch in (1, 8, 64):
+                ms, tps, _, wbytes = run_variant(
+                    kv_name, batch, weight=weight)
+                per_b[str(batch)] = {
+                    "ms_per_step": round(ms, 3),
+                    "tokens_per_sec_per_chip": round(tps, 1),
+                    "weight_stream_gbs": round(
+                        wbytes / ms * 1e3 / 1e9, 3),
+                }
+                log(f"decode w={weight} kv={kv_name} b{batch}: "
+                    f"{ms:.2f} ms/step, {tps:,.0f} tokens/s/chip")
+            per_w[f"kv_{kv_name}"] = per_b
+        per_w["weight_pool_bytes"] = wbytes
+        wq[weight] = per_w
+    wq["note"] = (
+        f"weight_block={WQ_BLOCK}; pool converted once by decode_fns "
+        "and streamed whole every step; CPU rows price the dequant "
+        "arithmetic — the bandwidth win is the weight_pool_bytes "
+        "column (projections shrink ~4x int8 / ~8x int4 under fp32; "
+        "embeddings/norms stay model-dtype, and this bench shape's "
+        "4096-vocab embedding dominates its tiny pool)")
+    rows["weight_quant"] = wq
 
     # mixed prefill+decode: a continuous-batching window at b=8 where
     # one slot re-admits (prefill) between decode windows — the
@@ -1332,7 +1379,8 @@ def child_decode():
                  "steps": STEPS, "warmup": WARMUP,
                  "mixed_prefix": MIX_PREFIX, "mixed_tail": MIX_TAIL,
                  "prefill_chunk": CHUNK, "speculate_k": SPEC_K,
-                 "spec_prompt": SPEC_PROMPT, "spec_new": SPEC_NEW},
+                 "spec_prompt": SPEC_PROMPT, "spec_new": SPEC_NEW,
+                 "weight_block": WQ_BLOCK},
     }))
 
 

@@ -1379,6 +1379,93 @@ def validate_fmha_decode(smoke=False):
     return results
 
 
+def validate_dequant_matmul(smoke=False):
+    """Weight-dequantizing matmul cells (the quantized-weight-pool
+    serving path): the in-tile dequant Pallas kernel vs the XLA
+    dequantize-then-dot reference across decode-shape dots — token
+    rows m in {1, 8, 64} x the three projection shapes a decode layer
+    streams (qkv h→3h, FFN up h→4h, FFN down 4h→h at h=2048) x weight
+    width {int8, packed int4}.
+
+    Ground truth is the fp32 dot against the MATERIALIZED dequantized
+    matrix under highest matmul precision — both implementations
+    compute that same math, so parity rides main()'s relative gate (1)
+    and the never-lose-to-XLA bar is gate (2): the kernel's entire
+    reason to exist is streaming FEWER bytes than the wide temp the
+    XLA path materializes, so a losing cell is a kernel bug.
+    ``weight_gbs`` is the number that matters at decode's
+    weight-streaming roofline: achieved quantized-weight bandwidth
+    (qweight + scales bytes per call)."""
+    from apex_tpu.ops.dequant_matmul import (
+        dequant_matmul,
+        dequantize_weight,
+        quantize_weight,
+    )
+
+    results = []
+    block = 128
+    ms = [1, 8, 64]
+    shapes = [("qkv", 2048, 6144), ("ffn_up", 2048, 8192),
+              ("ffn_down", 8192, 2048)]
+    widths = ["int8", "int4"]
+    if smoke:
+        ms, shapes = [8], [("qkv", 512, 1536)]
+    for name, k, n in shapes:
+        key = jax.random.PRNGKey(hash(name) % (1 << 31))
+        kw, kx = jax.random.split(key)
+        w = jax.random.normal(kw, (k, n), jnp.float32)
+        for wd in widths:
+            wq = quantize_weight(w, wd, block)
+            qv = wq["q8"] if wd == "int8" else wq["q4"]
+            scales = wq["scales"]
+            # ONE ground truth per (shape, width): the dequantized
+            # matrix both implementations encode, at full precision
+            with jax.default_matmul_precision("highest"):
+                wref = dequantize_weight(wq)
+            for m in ms:
+                x = jax.random.normal(kx, (m, k), jnp.float32)
+                with jax.default_matmul_precision("highest"):
+                    ref = jax.device_get(jnp.dot(x, wref))
+
+                def fwd_t(impl):
+                    return jax.jit(
+                        lambda x, qv, s: jnp.sum(dequant_matmul(
+                            x, qv, s, weight_dtype=wd,
+                            implementation=impl,
+                        ).astype(jnp.float32)))
+
+                run = lambda impl: jax.device_get(jax.jit(
+                    lambda x, qv, s: dequant_matmul(
+                        x, qv, s, weight_dtype=wd,
+                        implementation=impl))(x, qv, scales))
+                out_p = run("pallas")
+                out_x = run("xla")
+                iters = 10 if smoke else 50
+                p_ms = _time(fwd_t("pallas"), x, qv, scales,
+                             iters=iters)
+                x_ms = _time(fwd_t("xla"), x, qv, scales, iters=iters)
+                w_bytes = int(qv.nbytes) + int(scales.nbytes)
+                results.append({
+                    "kernel": "dequant_matmul",
+                    "proj": name,
+                    "shape": [m, k, n],
+                    "dtype": wd,
+                    "block_size": block,
+                    "auto_impl": "pallas",
+                    "fwd": {
+                        "pallas_ms": round(p_ms, 3),
+                        "xla_ms": round(x_ms, 3),
+                        "speedup": round(x_ms / p_ms, 2),
+                        "weight_gbs": round(
+                            w_bytes / (p_ms * 1e-3) / 1e9, 1),
+                        "max_err_vs_fp32": _max_err(out_p, ref),
+                        "xla_err_vs_fp32": _max_err(out_x, ref),
+                    },
+                })
+                print(json.dumps(results[-1]))
+    return results
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
@@ -1398,6 +1485,7 @@ def main():
     entries += validate_fused_dense(smoke=args.smoke)
     entries += validate_opt_tail(smoke=args.smoke)
     entries += validate_fmha_decode(smoke=args.smoke)
+    entries += validate_dequant_matmul(smoke=args.smoke)
     from apex_tpu.ops.attention_mid import mid_seq_threshold
     from apex_tpu.ops.attention_short import short_seq_threshold
     doc = {

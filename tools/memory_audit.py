@@ -34,6 +34,17 @@ Run on the 8-device virtual mesh (no TPU needed):
     python tools/memory_audit.py --compare            # flagship ≥1B shape
     python tools/memory_audit.py --compare --layers 2 --hidden 256
     python tools/memory_audit.py --train-steps 8 --layers 2 --hidden 256
+
+``--serve`` is the SERVING analog of the train audit: per-device
+decode-path bytes (weight pool + KV pool + decode activations) for a
+ladder of model tiers at every weight width — fp32 / bf16 / int8 /
+int4 pools (``quantize_gpt_weights``) — with an HBM verdict naming the
+largest tier that fits at each width.  Pure shape math (eval_shape of
+the actual pool builders, no compile, no materialization), so the 20B+
+tiers audit in milliseconds:
+
+    python tools/memory_audit.py --serve              # writes MEMORY_AUDIT_SERVE.json
+    python tools/memory_audit.py --serve --context 2048 --max-seqs 8
 """
 
 from __future__ import annotations
@@ -379,6 +390,157 @@ def train_zero3(vocab=None, layers=None, hidden=None, heads=None,
     }
 
 
+#: The serving tier ladder (all head_dim=128, gelu MLP): chosen so the
+#: 16 GB verdict lands one width apart per tier — fp32 carries the 3B,
+#: bf16 the 8B, int8 the 13B and int4 the 30B class.  The 13B/30B rows
+#: are the tentpole claim: those tiers fit ONLY quantized.
+SERVE_TIERS = (
+    ("1B", dict(vocab=32768, layers=20, hidden=2048, heads=16)),
+    ("3B", dict(vocab=32768, layers=32, hidden=2560, heads=20)),
+    ("8B", dict(vocab=32768, layers=32, hidden=4096, heads=32)),
+    ("13B", dict(vocab=32768, layers=40, hidden=5120, heads=40)),
+    ("30B", dict(vocab=32768, layers=44, hidden=6144, heads=48)),
+)
+
+WEIGHT_WIDTHS = ("fp32", "bf16", "int8", "int4")
+
+
+def _tree_bytes(tpl) -> int:
+    import jax
+    import numpy as np
+
+    return int(sum(
+        (int(np.prod(l.shape)) if l.shape else 1)
+        * np.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(tpl)))
+
+
+def _serve_weight_pool_bytes(model, width, block=128) -> int:
+    """Exact per-device bytes of the weight pool at ``width`` — from
+    ``eval_shape`` of the ACTUAL pool builder
+    (:func:`quantize_gpt_weights`), so scales, packing and the
+    full-precision embedding/norm leaves are counted as built, not
+    estimated.  Serving is dp-replicated: every device holds the whole
+    pool."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import (
+        QUANTIZED_WEIGHT_LEAVES, quantize_gpt_weights,
+    )
+
+    tpl = _param_template(model)
+    if width == "fp32":
+        return _tree_bytes(tpl)
+    if width == "bf16":
+        def cast(p):
+            layers = dict(p["layers"])
+            for name in QUANTIZED_WEIGHT_LEAVES:
+                if name in layers:
+                    leaf = dict(layers[name])
+                    leaf["weight"] = leaf["weight"].astype(jnp.bfloat16)
+                    layers[name] = leaf
+            return {**p, "layers": layers}
+
+        return _tree_bytes(jax.eval_shape(cast, tpl))
+    return _tree_bytes(jax.eval_shape(
+        lambda p: quantize_gpt_weights(p, width, block), tpl))
+
+
+def _serve_kv_pool_bytes(layers, heads, head_dim, *, max_seqs,
+                         context, page_size, kv_dtype) -> int:
+    """Exact paged-KV-pool bytes for the serving scenario, from
+    ``eval_shape`` of :func:`init_pools` (int8 pools carry their
+    per-block scales — counted, not approximated)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.serving.kv_cache import KVCacheConfig, init_pools
+
+    pages_per_seq = -(-context // page_size)
+    cfg = KVCacheConfig(
+        num_layers=layers, num_heads=heads, head_dim=head_dim,
+        num_pages=1 + max_seqs * pages_per_seq, page_size=page_size,
+        max_seqs=max_seqs, pages_per_seq=pages_per_seq,
+        dtype=jnp.float32, kv_dtype=kv_dtype)
+    return _tree_bytes(jax.eval_shape(lambda: init_pools(cfg)))
+
+
+def run_serve_audit(hbm_gb=DEFAULT_HBM_GB, max_seqs=4, context=1024,
+                    page_size=64, block=128) -> dict:
+    """The --serve document: per-device decode-path bytes (weight pool
+    + KV pool + decode activations) for every tier x weight width,
+    and the largest tier that fits per width.  KV rides int8 (the
+    shipping default since the paged-cache PR) with the fp32 pool
+    bytes reported alongside; activations are a structural estimate
+    (a handful of (max_seqs, ffn) rows plus the logits row — decode
+    activations are microscopic next to the pools)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.models import GPTConfig, GPTModel
+
+    hbm = hbm_gb * 1e9
+    tiers = []
+    largest_fit = {w: None for w in WEIGHT_WIDTHS}
+    for name, shape in SERVE_TIERS:
+        head_dim = shape["hidden"] // shape["heads"]
+        model = GPTModel(GPTConfig(
+            vocab_size=shape["vocab"], num_layers=shape["layers"],
+            hidden_size=shape["hidden"],
+            num_attention_heads=shape["heads"],
+            max_position_embeddings=context,
+            position_embedding="rope", compute_dtype=jnp.float32,
+            remat=False, attention_impl="xla",
+        ))
+        n_params = _n_params(_param_template(model))
+        kv = {
+            "fp32": _serve_kv_pool_bytes(
+                shape["layers"], shape["heads"], head_dim,
+                max_seqs=max_seqs, context=context,
+                page_size=page_size, kv_dtype=None),
+            "int8": _serve_kv_pool_bytes(
+                shape["layers"], shape["heads"], head_dim,
+                max_seqs=max_seqs, context=context,
+                page_size=page_size, kv_dtype=jnp.int8),
+        }
+        act = int(max_seqs * (4 * shape["hidden"] * 4 * 4
+                              + shape["vocab"] * 4))
+        row = {"tier": name, "shape": dict(shape),
+               "n_params": n_params, "kv_pool_bytes": kv,
+               "activations_bytes": act, "widths": {}}
+        for w in WEIGHT_WIDTHS:
+            wp = _serve_weight_pool_bytes(model, w, block)
+            total = wp + kv["int8"] + act
+            fits = total < hbm
+            row["widths"][w] = {
+                "weight_pool_bytes": wp,
+                "total_bytes": total,
+                "fits_hbm": bool(fits),
+            }
+            if fits:
+                largest_fit[w] = name     # tiers ascend in size
+        tiers.append(row)
+    only_quant = [
+        r["tier"] for r in tiers
+        if not r["widths"]["fp32"]["fits_hbm"]
+        and not r["widths"]["bf16"]["fits_hbm"]
+        and (r["widths"]["int8"]["fits_hbm"]
+             or r["widths"]["int4"]["fits_hbm"])
+    ]
+    return {
+        "metric": "serve_largest_fit_tier",
+        "value": {w: largest_fit[w] for w in WEIGHT_WIDTHS},
+        "unit": f"largest tier under {hbm_gb:g} GB HBM per weight "
+                f"width (int8 KV)",
+        "scenario": {"max_seqs": max_seqs, "context": context,
+                     "page_size": page_size, "weight_block": block,
+                     "kv_dtype": "int8"},
+        "hbm_limit_bytes": int(hbm),
+        "tiers": tiers,
+        "fits_only_quantized": only_quant,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=8)
@@ -398,9 +560,44 @@ def main():
                     help="ALSO run N real ZeRO-3 steps at the shape "
                          "(slow on CPU hosts; proves the config "
                          "trains, not just compiles)")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving audit: decode-path bytes per tier "
+                         "at fp32/bf16/int8/int4 weight widths "
+                         "(writes MEMORY_AUDIT_SERVE.json)")
+    ap.add_argument("--max-seqs", type=int, default=4,
+                    help="--serve: concurrent serving slots")
+    ap.add_argument("--context", type=int, default=1024,
+                    help="--serve: per-slot context budget (tokens)")
+    ap.add_argument("--page-size", type=int, default=64)
+    ap.add_argument("--weight-block", type=int, default=128,
+                    help="--serve: quantization block size")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     _force_virtual_devices(args.devices)
+
+    if args.serve:
+        doc = run_serve_audit(
+            hbm_gb=args.hbm_gb, max_seqs=args.max_seqs,
+            context=args.context, page_size=args.page_size,
+            block=args.weight_block)
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        out_path = args.out or os.path.join(
+            root, "MEMORY_AUDIT_SERVE.json")
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+        gb = 1e9
+        print(json.dumps({
+            "metric": doc["metric"], "value": doc["value"],
+            "fits_only_quantized": doc["fits_only_quantized"],
+            "tiers_gb": {
+                r["tier"]: {
+                    w: round(r["widths"][w]["total_bytes"] / gb, 2)
+                    for w in WEIGHT_WIDTHS}
+                for r in doc["tiers"]},
+        }))
+        print(f"wrote {out_path}")
+        return
 
     dims = dict(vocab=args.vocab, layers=args.layers,
                 hidden=args.hidden, heads=args.heads, seq=args.seq,

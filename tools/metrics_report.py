@@ -104,6 +104,7 @@ def summarize_serving(records: List[dict]) -> Optional[Dict[str, Any]]:
     if decode:
         windows = []
         itl: List[float] = []       # per-window mean inter-token s
+        wgbs: List[float] = []      # per-window weight-stream GB/s
         for r in decode:
             dur = float(r.get("dur_s", 0.0))
             steps = int(r.get("steps", 0))
@@ -114,12 +115,29 @@ def summarize_serving(records: List[dict]) -> Optional[Dict[str, Any]]:
                 w["tokens_per_sec"] = round(toks / dur, 1)
             if dur > 0 and steps:
                 itl.append(dur / steps)
+            # every decode step streams the whole weight pool once
+            # (serve.py stamps the per-step bytes on the span), so the
+            # window's achieved weight bandwidth is steps * bytes / dur
+            # — at small batch this IS the decode roofline, and the
+            # int8/int4 pools shrink the numerator, not the rate
+            wb = r.get("weight_bytes")
+            if dur > 0 and steps and wb:
+                g = round(steps * float(wb) / dur / 1e9, 6)
+                w["weight_stream_gbs"] = g
+                wgbs.append(g)
             windows.append(w)
         out["decode_windows"] = windows
         rates = [w["tokens_per_sec"] for w in windows
                  if "tokens_per_sec" in w]
         if rates:
             out["decode_tokens_per_sec"] = _stats(rates)
+        wdts = {r["weight_dtype"] for r in decode
+                if r.get("weight_dtype")}
+        if wdts:
+            out["weight_dtype"] = (sorted(wdts)[0] if len(wdts) == 1
+                                   else sorted(wdts))
+        if wgbs:
+            out["weight_stream_gbs"] = _stats(wgbs)
         if itl:
             # the harvest window quantizes this to window-mean
             # granularity (serve.py docstring) — percentiles are over
@@ -410,6 +428,7 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
                       "gbs",
                       # serving span / request / prefix-cache fields
                       "span", "steps", "slots", "tokens", "dur_s",
+                      "weight_dtype", "weight_bytes",
                       "uid", "slot", "reason", "new_tokens",
                       "ttft_s", "chunk", "start", "matched_tokens",
                       "shared_pages", "tokens_skipped", "copied",
@@ -501,6 +520,17 @@ def format_report(summary: Dict[str, Any]) -> str:
             lines.append(
                 f"  decode tokens/s per window: mean {s['mean']:.4g}  "
                 f"best {s['best']:.4g}  final {s['final']:.4g}")
+        if "weight_stream_gbs" in sv or "weight_dtype" in sv:
+            g = sv.get("weight_stream_gbs")
+            row = "  weight stream: "
+            if "weight_dtype" in sv:
+                wd = sv["weight_dtype"]
+                row += (wd if isinstance(wd, str) else "/".join(wd))
+                row += " weights"
+            if g:
+                row += (f", mean {g['mean']:.4g} GB/s  "
+                        f"best {g['best']:.4g} GB/s")
+            lines.append(row)
         if "inter_token_latency_ms" in sv:
             i = sv["inter_token_latency_ms"]
             lines.append(
