@@ -2,9 +2,10 @@
 
 The scenario layer of the serving stack ("heavy traffic from millions
 of users"): N :class:`~apex_tpu.serving.serve.ContinuousBatcher`
-replicas share ONE set of jitted decode step functions (dp-replicated —
-zero extra compilations) behind one router.  Two modules, one concern
-each:
+replicas share ONE set of jitted decode step functions (replicated or
+a tp-group ``decode_fns(tp=)`` build — either way zero extra
+compilations; the router sees batchers, not meshes) behind one
+router.  Two modules, one concern each:
 
 - :mod:`~apex_tpu.fleet.router` — the :class:`FleetRouter` and its
   declarative :class:`FleetPolicy`: per-request SLO classes with

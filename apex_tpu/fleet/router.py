@@ -3,11 +3,13 @@
 The serving stack below this module tops out at one
 :class:`~apex_tpu.serving.serve.ContinuousBatcher` — one chip's worth
 of users, no notion of a latency class, and a single point of failure.
-This module is the scenario layer on top: N batcher replicas
-(dp-replicated ``decode_fns`` — the SAME jitted step functions drive
-every replica, each over its own cache and pools, so the fleet adds
-ZERO compilations) behind one :class:`FleetRouter` that decides, per
-request, WHO serves it and WHEN.
+This module is the scenario layer on top: N batcher replicas (the
+SAME jitted ``decode_fns`` step functions drive every replica, each
+over its own cache and pools, so the fleet adds ZERO compilations; a
+replica may equally be a tp *group* wrapping a ``decode_fns(tp=)``
+sharded build — the router never sees the mesh) behind one
+:class:`FleetRouter` that decides, per request, WHO serves it and
+WHEN.
 
 Everything the router needs already exists as host-side mirrors — the
 design rule is **no new host syncs**:

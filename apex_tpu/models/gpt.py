@@ -57,7 +57,8 @@ from apex_tpu.transformer.tensor_parallel.random import (
 from apex_tpu._compat import axis_size as _axis_size
 
 __all__ = ["GPTConfig", "GPTModel", "GPTDecodeFns",
-           "quantize_gpt_weights", "QUANTIZED_WEIGHT_LEAVES"]
+           "quantize_gpt_weights", "QUANTIZED_WEIGHT_LEAVES",
+           "COLUMN_PARALLEL_LEAVES", "ROW_PARALLEL_LEAVES"]
 
 
 @dataclasses.dataclass
@@ -95,13 +96,17 @@ class GPTDecodeFns:
     #: ``decode.weight_dtype`` so the batcher's telemetry can report
     #: the width without seeing the params.
     weight_dtype: Any = None
-    #: bytes of model parameters ONE decode step streams from HBM (the
-    #: whole pool: projections at their quantized width + scales,
-    #: embedding/norms at full width).  Mirrored as
+    #: bytes of model parameters ONE CHIP streams per decode step (its
+    #: own shard of the pool: sharded projections/scales/embedding at
+    #: 1/tp, replicated norms in full).  Mirrored as
     #: ``decode.weight_stream_bytes``; with the span durations this is
-    #: the serving weight-stream GB/s headline
+    #: the serving per-chip weight-stream GB/s headline
     #: (tools/metrics_report.py).
     weight_stream_bytes: Any = None
+    #: tensor-parallel degree the steps were compiled for (1 =
+    #: dp-replicated serving).  Mirrored as ``decode.tp`` so the
+    #: batcher's telemetry can stamp it on decode spans.
+    tp: Any = None
 
 
 #: the projection weight leaves :func:`quantize_gpt_weights` converts —
@@ -111,11 +116,51 @@ class GPTDecodeFns:
 #: disproportionately sensitive.
 QUANTIZED_WEIGHT_LEAVES = ("qkv", "attn_proj", "fc1", "fc_gate", "fc2")
 
+#: how each quantized leaf shards over "tp": COLUMN leaves slice the
+#: OUTPUT features (their scale blocks ride along), ROW leaves slice
+#: the contraction dim (blocks along n are untouched) — the exact
+#: mirror of the ColumnParallelLinear / RowParallelLinear param specs
+#: the full-width path uses.
+COLUMN_PARALLEL_LEAVES = ("qkv", "fc1", "fc_gate")
+ROW_PARALLEL_LEAVES = ("attn_proj", "fc2")
+
+
+def _check_quantized_tp(name: str, k: int, n: int, weight_dtype: str,
+                        block_size: int, tp: int) -> None:
+    """Loud build-time divisibility for a tp-sharded quantized leaf:
+    every shard must hold whole scale blocks (column leaves slice the
+    output features, row leaves the contraction rows) and — for int4 —
+    whole packed halves, or the in-kernel dequant tiling desyncs."""
+    if name in ROW_PARALLEL_LEAVES:
+        if k % tp:
+            raise ValueError(
+                f"layers/{name}: contraction dim {k} is not divisible "
+                f"by tp={tp}")
+        return
+    if n % tp:
+        raise ValueError(
+            f"layers/{name}: output dim {n} is not divisible by "
+            f"tp={tp}")
+    n_local = n // tp
+    if n_local % block_size:
+        raise ValueError(
+            f"layers/{name}: per-shard output width {n_local} "
+            f"(= {n} / tp={tp}) is not a multiple of "
+            f"block_size={block_size} — shard boundaries must align "
+            f"with scale blocks; pick a smaller block_size")
+    if weight_dtype == "int4" and n_local % (2 * block_size):
+        raise ValueError(
+            f"layers/{name}: the int4 halves layout needs the "
+            f"per-shard width {n_local} (= {n} / tp={tp}) to be a "
+            f"multiple of 2 * block_size = {2 * block_size}; pick a "
+            f"smaller even block_size")
+
 
 def quantize_gpt_weights(
     params: Dict[str, Any],
     weight_dtype: str,
     block_size: int = 128,
+    tp: int = 1,
 ) -> Dict[str, Any]:
     """Convert a GPT param tree's projection weights to a quantized
     weight pool — ONCE, at checkpoint load.
@@ -137,13 +182,29 @@ def quantize_gpt_weights(
     so quantizing an ``unshard()``-rebuilt ZeRO-3 checkpoint is
     bit-identical to quantizing the replicated weights directly
     (pinned in tests/test_weight_quant.py), and ONE pool can be built
-    host-side and shared read-only by every fleet replica."""
+    host-side and shared read-only by every fleet replica.
+
+    ``tp``: the tensor-parallel degree the pool will SERVE at.  Scale
+    values and int8 bytes are tp-independent (shard boundaries align
+    with whole scale blocks — validated loudly), but int4 COLUMN leaves
+    pack their nibbles per tp shard: a contiguous slice of globally
+    packed bytes would pair nibbles from two non-contiguous column
+    ranges, so each shard's columns are packed among themselves and the
+    GSPMD slice of the packed array is exactly that shard's own halves
+    layout.  At tp=1 this IS the historical whole-row layout; the
+    dequantized values are bit-identical at every tp.  A pre-built int4
+    pool handed to :meth:`GPTModel.decode_fns` at tp>1 must have been
+    packed with the SAME tp (the bytes carry no marker — int8 pools
+    are tp-agnostic)."""
     from apex_tpu.ops.dequant_matmul import quantize_weight
 
     if weight_dtype not in ("int8", "int4"):
         raise ValueError(
             f"weight_dtype must be 'int8' or 'int4', got "
             f"{weight_dtype!r}")
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
     out = dict(params)
     layers = dict(params["layers"])
     for name in QUANTIZED_WEIGHT_LEAVES:
@@ -152,17 +213,96 @@ def quantize_gpt_weights(
         leaf = dict(layers[name])
         w = leaf.pop("weight")
         L, k, n = w.shape
+        lname = f"layers/{name}.weight"
+        if tp > 1:
+            _check_quantized_tp(name, k, n, weight_dtype, block_size,
+                                tp)
         # rows are independent: the stacked (L, k, n) quantizes as
         # L*k rows of n, bit-identical to a per-layer loop
-        wq = quantize_weight(
-            jnp.reshape(w, (L * k, n)), weight_dtype, block_size,
-            leaf=f"layers/{name}.weight")
+        w2 = jnp.reshape(w, (L * k, n))
+        if (weight_dtype == "int4" and tp > 1
+                and name in COLUMN_PARALLEL_LEAVES):
+            shards = [
+                quantize_weight(
+                    w2[:, r * (n // tp):(r + 1) * (n // tp)],
+                    weight_dtype, block_size, leaf=lname)
+                for r in range(tp)
+            ]
+            wq = {key: jnp.concatenate([s[key] for s in shards], axis=1)
+                  for key in shards[0]}
+        else:
+            wq = quantize_weight(w2, weight_dtype, block_size,
+                                 leaf=lname)
         qkey = "q8" if "q8" in wq else "q4"
         leaf[qkey] = jnp.reshape(wq[qkey], (L, k, -1))
         leaf["scales"] = jnp.reshape(wq["scales"], (L, k, -1))
         layers[name] = leaf
     out["layers"] = layers
     return out
+
+
+def _quantized_layer_specs(lspecs: Dict[str, Any],
+                           layers: Dict[str, Any],
+                           axis_name: str, tp: int) -> Dict[str, Any]:
+    """Partition specs for the quantized-pool leaves, mirroring the
+    pytree structure :func:`quantize_gpt_weights` built.  At tp=1
+    everything is replicated (the historical serving layout — specs
+    stay byte-identical to older builds); at tp>1 column leaves shard
+    ``q8``/``q4``/``scales`` on the stacked OUTPUT dim (axis 2 of
+    ``(L, k, ·)``) with the bias riding along, and row leaves shard on
+    the contraction dim (axis 1) with a replicated bias — so each chip
+    streams exactly 1/tp of the quantized pool."""
+    out = dict(lspecs)
+    for name in QUANTIZED_WEIGHT_LEAVES:
+        if name not in out or name not in layers:
+            continue
+        leaf = layers[name]
+        if "q8" not in leaf and "q4" not in leaf:
+            continue
+        if tp == 1:
+            out[name] = jax.tree.map(lambda _: P(), leaf)
+            continue
+        col = name in COLUMN_PARALLEL_LEAVES
+        spec = {}
+        for key in leaf:
+            if key == "bias":
+                spec[key] = P(None, axis_name) if col else P(None, None)
+            elif col:
+                spec[key] = P(None, None, axis_name)
+            else:
+                spec[key] = P(None, axis_name, None)
+        out[name] = spec
+    return out
+
+
+def _per_chip_param_bytes(params: Dict[str, Any], specs: Dict[str, Any],
+                          mesh) -> int:
+    """Bytes of model parameters ONE device holds — and one decode step
+    streams — under ``specs``: each leaf's nbytes divided by the
+    product of its spec's mesh-axis extents (replicated leaves count in
+    full).  The per-chip numerator of the serving weight-stream GB/s
+    headline."""
+    extents = dict(mesh.shape)
+
+    def denom(spec):
+        d = 1
+        for entry in tuple(spec):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            for a in names:
+                d *= int(extents.get(a, 1))
+        return d
+
+    p_leaves = jax.tree.leaves(params)
+    s_leaves = jax.tree.leaves(specs,
+                               is_leaf=lambda t: isinstance(t, P))
+    if len(p_leaves) != len(s_leaves):
+        raise ValueError(
+            f"param/spec tree mismatch: {len(p_leaves)} param leaves "
+            f"vs {len(s_leaves)} specs")
+    return int(sum(x.nbytes // denom(s)
+                   for x, s in zip(p_leaves, s_leaves)))
 
 
 @dataclasses.dataclass
@@ -487,8 +627,13 @@ class GPTModel:
         never materializes in HBM.  Structure is static at trace time,
         so the width costs no dynamic flag threading and each width
         compiles to its own fixed-shape program.  The quantized branch
-        skips the tp collectives: quantized pools exist only on the
-        serving path, which :meth:`decode_fns` pins to tp=pp=1."""
+        mirrors the module's tp collectives: a column-parallel leaf's
+        local dot IS its output shard (bias shards with it), a
+        row-parallel leaf's local dot is a partial sum over its slice
+        of the contraction dim — psum exactly like
+        ``RowParallelLinear.apply``, then add the replicated bias once.
+        At tp=1 both reduce to the historical dot+bias (the collective
+        is skipped at trace time)."""
         if "weight" in p:
             return mod.apply(p, y)
         from apex_tpu.ops.dequant_matmul import (
@@ -498,6 +643,14 @@ class GPTModel:
         out = dequant_matmul(
             y, p["q8"] if "q8" in p else p["q4"], p["scales"],
             weight_dtype=weight_pool_dtype(p))
+        if (isinstance(mod, RowParallelLinear)
+                and _axis_size(mod.axis_name) > 1):
+            from apex_tpu.transformer.tensor_parallel.mappings import (
+                reduce_from_tensor_model_parallel_region,
+            )
+
+            out = reduce_from_tensor_model_parallel_region(
+                out, mod.axis_name)
         if "bias" in p:
             out = out + p["bias"].astype(out.dtype)
         return out
@@ -886,7 +1039,7 @@ class GPTModel:
 
         c = self.config
         if self.moe is not None:
-            raise NotImplementedError("MoE decode is not supported")
+            self.moe.decode()    # raises: expert-parallel decode note
         self._check_weight_dtype(params, weight_dtype)
         C = tokens.shape[-1]
         tokens = tokens.reshape(1, C)
@@ -993,7 +1146,7 @@ class GPTModel:
 
         c = self.config
         if self.moe is not None:
-            raise NotImplementedError("MoE decode is not supported")
+            self.moe.decode()    # raises: expert-parallel decode note
         self._check_weight_dtype(params, weight_dtype)
         S = tokens.shape[0]
         page_size = pools["k"].shape[3]
@@ -1107,7 +1260,7 @@ class GPTModel:
 
         c = self.config
         if self.moe is not None:
-            raise NotImplementedError("MoE decode is not supported")
+            self.moe.decode()    # raises: expert-parallel decode note
         self._check_weight_dtype(params, weight_dtype)
         S, R = tokens.shape
         page_size = pools["k"].shape[3]
@@ -1196,6 +1349,7 @@ class GPTModel:
         draft_model: Optional[Any] = None,
         weight_dtype: Optional[str] = None,
         weight_block: int = 128,
+        tp: Optional[int] = None,
     ):
         """Build the jitted serving step functions the
         continuous-batching driver
@@ -1240,9 +1394,22 @@ class GPTModel:
         on the returned struct and on ``decode`` for the batcher's
         telemetry.
 
-        Serving runs dp-replicated on the mesh; tensor/pipeline/
-        context-parallel decode is not implemented (the cache pools
-        would need head-sharding) and is rejected loudly."""
+        Tensor-parallel decode: when the mesh carries a "tp" extent
+        > 1 the whole stack shards over it — KV pools head-shard on
+        pool axis 2 (each shard owns its head slice of every layer's
+        pool; page tables and the host allocator stay replicated, so
+        ONE free list drives every shard and prefix cache / CoW /
+        refcount GC work verbatim), quantized weight pools shard
+        column/row-wise through ``dequant_matmul`` (each chip streams
+        1/tp of the pool, scales with their blocks), and the
+        vocab-parallel logits all-gather ONLY at the sampling seam so
+        the fused sampler, Gumbel-coupled acceptance and per-slot key
+        schedule are untouched and the output is token-identical to
+        the tp=1 replicated reference.  ``tp=`` is an optional
+        cross-check against the mesh (the mesh is the source of
+        truth); one warmup compile per (width, tp) pair, zero
+        recompiles after.  Pipeline/context-parallel decode stays
+        rejected loudly."""
         from apex_tpu.serving.kv_cache import (
             init_pools, write_targets, write_tokens,
         )
@@ -1252,7 +1419,7 @@ class GPTModel:
 
         c = self.config
         if self.moe is not None:
-            raise NotImplementedError("MoE decode is not supported")
+            self.moe.decode()    # raises: expert-parallel decode note
         if draft_model is not None:
             raise NotImplementedError(
                 "draft-model speculation is a stub: the verify step, "
@@ -1261,11 +1428,21 @@ class GPTModel:
                 "decode loop per step is not wired up — use "
                 "self-speculation (speculate_k=K with the host n-gram "
                 "draft source, apex_tpu.serving.speculate)")
-        if parallel_state.get_tensor_model_parallel_world_size() > 1 or \
-                parallel_state.get_pipeline_model_parallel_world_size() > 1:
+        if parallel_state.get_pipeline_model_parallel_world_size() > 1:
             raise NotImplementedError(
-                "serving decode is dp-replicated: initialize the mesh "
-                "with tp=pp=1 (head-sharded cache pools are future work)")
+                "serving decode does not pipeline: initialize the mesh "
+                "with pp=1 (decode shards over tp — see decode_fns(tp=))")
+        tp_size = int(dict(mesh.shape).get(self.axis_name, 1))
+        if tp is not None and int(tp) != tp_size:
+            raise ValueError(
+                f"decode_fns(tp={tp}) disagrees with the mesh's "
+                f"'{self.axis_name}' extent ({tp_size}) — the mesh is "
+                f"the source of truth; build a mesh with tp={tp}")
+        if c.num_attention_heads % tp_size:
+            raise ValueError(
+                f"tensor-parallel decode head-shards the KV pools: "
+                f"num_attention_heads={c.num_attention_heads} must be "
+                f"divisible by tp={tp_size}")
         cfg = cache_config
         if (cfg.num_layers != c.num_layers
                 or cfg.num_heads != c.num_attention_heads
@@ -1295,9 +1472,9 @@ class GPTModel:
                         f"the params already carry a {wd_in} pool")
             else:
                 # the ONE conversion — at build (= checkpoint-load)
-                # time, never per step
+                # time, never per step; packed for THIS tp degree
                 params = quantize_gpt_weights(
-                    params, weight_dtype, weight_block)
+                    params, weight_dtype, weight_block, tp=tp_size)
         elif weight_dtype == "bf16" and wd_in == "float32":
             layers = dict(params["layers"])
             for name in QUANTIZED_WEIGHT_LEAVES:
@@ -1307,21 +1484,54 @@ class GPTModel:
                     layers[name] = leaf
             params = {**params, "layers": layers}
         wd_active = self._weight_pool_dtype(params)
+        if wd_active in ("int8", "int4") and tp_size > 1:
+            # divisibility is checkable after the fact (pre-built pools
+            # included); int4 packing tp is NOT — the bytes carry no
+            # marker, so a pre-built int4 pool must have been packed
+            # with quantize_gpt_weights(tp=tp) (docstring there)
+            from apex_tpu.ops.dequant_matmul import weight_pool_block
+
+            for name in QUANTIZED_WEIGHT_LEAVES:
+                leaf = params["layers"].get(name)
+                if leaf is None:
+                    continue
+                blk = weight_pool_block(leaf)
+                n = leaf["scales"].shape[-1] * blk
+                _check_quantized_tp(name, leaf["scales"].shape[1], n,
+                                    wd_active, blk, tp_size)
 
         specs = self.param_specs()
         if wd_active in ("int8", "int4"):
-            # the spec tree must mirror the quantized pytree structure;
-            # serving is pinned to tp=pp=1 above, so replicated specs
-            # are exact for the new leaves
-            lspecs = dict(specs["layers"])
-            for name in QUANTIZED_WEIGHT_LEAVES:
-                if name in lspecs:
-                    lspecs[name] = jax.tree.map(
-                        lambda _: P(), params["layers"][name])
-            specs["layers"] = lspecs
+            # the spec tree must mirror the quantized pytree structure:
+            # replicated at tp=1 (the historical layout), column/row
+            # sharded at tp>1 so each chip streams 1/tp of the pool
+            specs["layers"] = _quantized_layer_specs(
+                specs["layers"], params["layers"], self.axis_name,
+                tp_size)
         pool_tmpl = jax.eval_shape(lambda: init_pools(cfg))
-        pool_specs = jax.tree.map(lambda _: P(), pool_tmpl)
+        # KV pools (L, num_pages, h, page_size, d) head-shard on axis 2
+        # at tp>1: each shard owns its head slice of every layer's
+        # pool, while page tables / write targets / the host allocator
+        # stay replicated — ONE shared free list drives every shard, so
+        # tables are identical across shards by construction
+        pool_sharding = (P(None, None, self.axis_name, None, None)
+                         if tp_size > 1 else P())
+        pool_specs = jax.tree.map(lambda _: pool_sharding, pool_tmpl)
         rep = lambda tree: jax.tree.map(lambda _: P(), tree)
+        if tp_size > 1:
+            from apex_tpu.transformer.tensor_parallel.mappings import (
+                gather_from_tensor_model_parallel_region,
+            )
+
+            # the ONE sampling seam: vocab-parallel logits all-gather
+            # to the full (replicated) vocab right before the sampler,
+            # so sample / spec_accept / the per-slot key schedule see
+            # exactly the tensors the tp=1 path sees
+            _full_logits = functools.partial(
+                gather_from_tensor_model_parallel_region,
+                axis_name=self.axis_name)
+        else:
+            _full_logits = lambda l: l
 
         def _prefill(params, pools, toks, length, page_row, key):
             hidden, ks, vs = self.prefill_forward(params, toks)
@@ -1338,7 +1548,8 @@ class GPTModel:
 
             pools = jax.vmap(write_layer)(pools, ks, vs)
             last = jnp.take(hidden[0], length - 1, axis=0)  # (h,)
-            logits = self.logits(params, last[None, None])[0, 0]
+            logits = _full_logits(
+                self.logits(params, last[None, None])[0, 0])
             # the draw after L context tokens folds L into the slot key
             # — the ONE key schedule shared with _chunk and _decode, so
             # chunked and monolithic prefill sample identically
@@ -1352,6 +1563,7 @@ class GPTModel:
                 params, toks, start, plen, write_from, page_row,
                 pools, quantized=cfg.quantized, kv_block=cfg.kv_block,
                 weight_dtype=wd_active)
+            logits = _full_logits(logits)
             tok = sample(logits[None], jax.random.fold_in(key, plen),
                          temperature, top_k, top_p)[0]
             return pools, tok, logits
@@ -1362,6 +1574,7 @@ class GPTModel:
                 params, carry["tokens"], carry["lengths"], active,
                 page_table, pools, quantized=cfg.quantized,
                 kv_block=cfg.kv_block, weight_dtype=wd_active)
+            logits = _full_logits(logits)
             if temperature == 0.0:
                 sampled = sample(logits, None, 0.0)
             else:
@@ -1408,6 +1621,7 @@ class GPTModel:
                 params, rows, lengths, active, valid, page_table,
                 pools, quantized=cfg.quantized, kv_block=cfg.kv_block,
                 weight_dtype=wd_active)
+            logits = _full_logits(logits)
             # row j's draw sits after lengths + 1 + j context tokens —
             # fold exactly what the plain one-token loop would fold at
             # that position, so the committed stream is key-schedule
@@ -1472,12 +1686,16 @@ class GPTModel:
         # the batcher only sees the callables; stamp the freeze id so
         # it can reject a host truncation id the device disagrees with
         decode.eos_id = eos_id
-        # ONE decode step streams the whole pool: projections at the
-        # active width (+ fp32 scales), embedding/norms full width —
-        # the numerator of the serving weight-stream GB/s headline
-        wbytes = int(sum(x.nbytes for x in jax.tree.leaves(params)))
+        # ONE decode step streams this chip's OWN slice of the pool:
+        # sharded projections (at the active width, + their fp32
+        # scales) and the vocab-sharded embedding at 1/tp, replicated
+        # norms in full — the per-chip numerator of the serving
+        # weight-stream GB/s headline (at tp=1 this is the whole pool,
+        # byte-identical to the historical stamp)
+        wbytes = _per_chip_param_bytes(params, specs, mesh)
         decode.weight_dtype = wd_active
         decode.weight_stream_bytes = wbytes
+        decode.tp = tp_size
         chunk = cj = None
         if prefill_chunk is not None:
             from apex_tpu.ops.attention_decode import (
@@ -1572,6 +1790,7 @@ class GPTModel:
                          else int(speculate_k)),
             weight_dtype=wd_active,
             weight_stream_bytes=wbytes,
+            tp=tp_size,
         )
 
     def generate(

@@ -317,6 +317,7 @@ class ContinuousBatcher:
         self.weight_dtype = getattr(decode_fn, "weight_dtype", None)
         self.weight_stream_bytes = getattr(
             decode_fn, "weight_stream_bytes", None)
+        self.tp = getattr(decode_fn, "tp", None)
         self.prefill_chunk = (None if prefill_chunk is None
                               else int(prefill_chunk))
         self.prefix_cache = bool(prefix_cache)
@@ -361,13 +362,17 @@ class ContinuousBatcher:
     def _weight_fields(self) -> dict:
         """The decode-span weight-stream fields (only when the decode
         step declared its pool): the width label plus the bytes ONE
-        step streams — ``steps * weight_bytes / dur_s`` is the
-        window's weight-stream GB/s."""
+        CHIP streams per step — ``steps * weight_bytes / dur_s`` is
+        the window's per-chip weight-stream GB/s — and the
+        tensor-parallel degree the step was compiled for, stamped
+        exactly like ``weight_dtype``."""
         if self.weight_dtype is None:
             return {}
         f = {"weight_dtype": self.weight_dtype}
         if self.weight_stream_bytes is not None:
             f["weight_bytes"] = int(self.weight_stream_bytes)
+        if self.tp is not None:
+            f["tp"] = int(self.tp)
         return f
 
     def _emit_gauges(self, queue_depth: int) -> None:

@@ -221,3 +221,33 @@ class MoEMLP:
             "nec,ech->nh", combine_mask, combined.astype(x.dtype)
         )
         return out.reshape(b, s, h), aux
+
+    def decode(self, *args, **kwargs):
+        """Single-token serving decode through the expert layer —
+        NOT implemented; raises loudly rather than silently serving a
+        dense approximation.
+
+        The training path above is built around fixed per-(expert,
+        source-rank) capacity and two ``lax.all_to_all`` hops sized for
+        full sequences; a decode step routes ONE token per slot, so
+        the same capacity math degenerates (cap rounds up to 1 and the
+        all_to_all moves mostly padding).  A real expert-parallel
+        decode wants: (a) slot-major top-k routing with no capacity
+        drops (a dropped token is a corrupted generation, not a
+        training regularizer), (b) expert weights resident per ep rank
+        with the token batch gathered to its experts — an all_to_all
+        over at most ``max_seqs`` rows, or replicated experts below
+        the memory crossover, and (c) the page-table/sampler contract
+        untouched (routing is per-token state-free, so the paged KV
+        pool and the per-slot key schedule need no changes).  That is
+        its own PR; until then the serving stack refuses MoE models at
+        decode_fns-build time via this error.
+        """
+        raise NotImplementedError(
+            "MoEMLP.decode: expert-parallel serving decode is not "
+            "implemented — the training path's capacity-bounded "
+            "all_to_all does not degenerate safely to one token per "
+            "slot (see the design note in MoEMLP.decode's docstring). "
+            "Serve a dense-MLP model, or distill the experts before "
+            "deployment."
+        )

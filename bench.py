@@ -988,12 +988,22 @@ def child_decode():
     batch {8, 64, 256}, and the SPECULATIVE rows: n-gram
     draft-and-verify (k=4) vs the plain step at batch {1, 8, 64} on
     repetitive vs adversarial prompts — tokens/s plus
-    accepted-tokens/step.  Runs the flagship CPU-dryrun GPT shape on ONE
-    device so "per chip" is honest; always a CPU measurement here, so
+    accepted-tokens/step, plus the TENSOR-PARALLEL rows: the sharded
+    decode step at tp {1, 2, 4} x weight {bf16, int8, int4} with
+    per-chip pool bytes and weight-stream GB/s/chip.  Runs the
+    flagship CPU-dryrun GPT shape on ONE device (tp rows shard over
+    virtual devices) so "per chip" is honest; always a CPU measurement here, so
     per the PR 3 convention ``vs_baseline`` is null — the row tracks
     that the serving stack stays runnable and how the variants rank,
     not a TPU rate."""
     _pin_cpu()
+    # the tensor-parallel rows below shard over up to 4 virtual
+    # devices — force the host split BEFORE jax initialises
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     import jax
     import jax.numpy as jnp
 
@@ -1025,7 +1035,8 @@ def child_decode():
     # every one AND keeps whole blocks per int4 nibble half
     WQ_BLOCK = 64
 
-    def run_variant(kv_name, batch, weight=None):
+    def run_variant(kv_name, batch, weight=None, mesh=mesh,
+                    wq_block=WQ_BLOCK):
         kv_dtype = jnp.int8 if kv_name == "int8" else None
         dtype = (jnp.float32 if kv_name == "float32"
                  else jnp.bfloat16)
@@ -1040,7 +1051,7 @@ def child_decode():
         fns = model.decode_fns(params, mesh, cfg,
                                max_prompt_len=PROMPT,
                                weight_dtype=weight,
-                               weight_block=WQ_BLOCK)
+                               weight_block=wq_block)
         cache = PagedKVCache(cfg)
         pools = init_pools(cfg)
         carry = init_carry(batch)
@@ -1359,6 +1370,51 @@ def child_decode():
         "the weight-stream win — see docs/serving.md")
     rows["speculative"] = speculative
 
+    # ---- tensor-parallel rows: the SAME decode step sharded over a
+    # tp group (head-sharded KV pool + column/row-split projections,
+    # logits gathered only at the sampling seam) at tp {1, 2, 4} x
+    # weight {bf16, int8, int4}, one decode batch.  tokens/s/chip
+    # divides by tp — on CPU the shard_map partitions fight for the
+    # same cores so the wall ratio is pessimistic; the number that
+    # transfers is per_chip_weight_pool_bytes (each chip streams 1/tp
+    # of the pool, ~1/16th of bf16 at tp=4 x int4 — the weight-stream
+    # roofline the tentpole moves).  Block 32 so the int4 per-shard
+    # packing divides the tp=4 projection slices (qkv 768 -> 192/chip).
+    TP_BLOCK, TP_BATCH = 32, 8
+    tp_rows = {}
+    for tp in (1, 2, 4):
+        parallel_state.destroy_model_parallel()
+        tmesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=tp,
+            devices=jax.devices()[:tp])
+        per_w = {}
+        for weight in ("bf16", "int8", "int4"):
+            ms, tps, _, wbytes = run_variant(
+                "bfloat16", TP_BATCH, weight=weight, mesh=tmesh,
+                wq_block=TP_BLOCK)
+            per_w[weight] = {
+                "ms_per_step": round(ms, 3),
+                "tokens_per_sec_per_chip": round(tps / tp, 1),
+                "per_chip_weight_pool_bytes": wbytes,
+                "weight_stream_gbs_per_chip": round(
+                    wbytes / ms * 1e3 / 1e9, 3),
+            }
+            log(f"decode tp={tp} w={weight} b{TP_BATCH}: "
+                f"{ms:.2f} ms/step, {tps / tp:,.0f} tokens/s/chip, "
+                f"{wbytes / 1e6:.2f} MB/chip pool")
+        tp_rows[str(tp)] = per_w
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        devices=jax.devices()[:1])
+    tp_rows["note"] = (
+        f"b={TP_BATCH}, bf16 KV, weight_block={TP_BLOCK} (int4 "
+        "per-shard packing needs the tp=4 projection slice divisible "
+        "by 2*block); virtual CPU devices share cores, so ms/step "
+        "rises with tp here — read the per-chip pool bytes column; "
+        "output is token-identical across tp (pinned in "
+        "tests/test_tp_decode.py)")
+    rows["tensor_parallel"] = tp_rows
+
     best = max(v["tokens_per_sec_per_chip"]
                for v in rows["bfloat16"].values())
     print(json.dumps({
@@ -1380,7 +1436,8 @@ def child_decode():
                  "mixed_prefix": MIX_PREFIX, "mixed_tail": MIX_TAIL,
                  "prefill_chunk": CHUNK, "speculate_k": SPEC_K,
                  "spec_prompt": SPEC_PROMPT, "spec_new": SPEC_NEW,
-                 "weight_block": WQ_BLOCK},
+                 "weight_block": WQ_BLOCK, "tp_batch": TP_BATCH,
+                 "tp_weight_block": TP_BLOCK},
     }))
 
 

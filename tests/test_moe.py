@@ -313,3 +313,30 @@ def test_moe_aux_threads_through_pipeline():
         )
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def test_moe_decode_raises_with_design_note():
+    """The serving decode path through an expert layer must refuse
+    LOUDLY (silent dense fallback would corrupt generations); the
+    error carries the expert-parallel design pointer, and every
+    gpt.py decode entry point routes through it."""
+    layer = MoEMLP(H, F, E)
+    with pytest.raises(NotImplementedError,
+                       match="expert-parallel serving decode"):
+        layer.decode()
+
+    from apex_tpu.models import GPTConfig, GPTModel
+
+    model = GPTModel(GPTConfig(
+        vocab_size=64, num_layers=2, hidden_size=H,
+        num_attention_heads=2, max_position_embeddings=32,
+        num_experts=E, compute_dtype=jnp.float32, remat=False,
+        attention_impl="xla"))
+    # the guard fires before any argument is touched — decode through
+    # an MoE model is refused at every serving entry point
+    for entry, nargs in ((model.decode_step, 6),
+                         (model.prefill_chunk, 7),
+                         (model.verify_step, 7)):
+        with pytest.raises(NotImplementedError,
+                           match="expert-parallel serving decode"):
+            entry(*([None] * nargs))

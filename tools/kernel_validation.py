@@ -984,7 +984,11 @@ def validate_fmha_decode(smoke=False):
     {bf16, fp32, int8}, plus chunked-prefill cells at s_q in {64, 256}
     (the scheduler's prompt-ingestion chunk attending over cache + its
     own just-written pages, held to the same never-lose-to-XLA bar as
-    s_q=1) — plus the end-to-end gate: GREEDY generation through the
+    s_q=1), plus head-sharded cells at tp in {2, 4} (a tensor-parallel
+    shard's local h/tp slice of the pool at the SAME shuffled page
+    table + ragged lengths every shard shares, with the shard concat
+    checked against the full-h call) — plus the end-to-end gate:
+    GREEDY generation through the
     full serving stack (paged cache + fmha_decode + continuous
     batching, monolithic AND chunked prefill) must produce
     token-identical output to the naive full-recompute reference at
@@ -1315,6 +1319,139 @@ def validate_fmha_decode(smoke=False):
                         kv_bytes / (p_ms * 1e-3) / 1e9, 1),
                     "max_err_vs_fp32": _max_err(out_p, ref),
                     "xla_err_vs_fp32": _max_err(out_x, ref),
+                },
+            })
+            print(json.dumps(results[-1]))
+
+    # ---- head-sharded cells: the tensor-parallel decode layout.  A
+    # tp shard calls fmha_decode on its OWN head slice of the pool
+    # ((pages, h/tp, ps, d) — heads are independent in attention, so
+    # no kernel change) while every shard drives the SAME shuffled
+    # page table and ragged lengths: that is the shared-free-list
+    # invariant the serving tp contract rests on.  Each cell runs all
+    # tp shards, checks the head-concat of the shard outputs against
+    # the full-h single-call output (must be the identical math) AND
+    # against the fp32 reference, and times one shard — the per-shard
+    # KV stream is 1/tp of the bytes, which is the whole point.  Same
+    # parity gate (1) and never-lose-to-XLA gate (2) as every other
+    # decode row.
+    import numpy as np
+
+    hs_h = 8
+    hs_tps = [2] if smoke else [2, 4]
+    hs_kvs = ["bfloat16"] if smoke else ["bfloat16", "int8"]
+    b, cache = 8, (512 if smoke else 2048)
+    npp = cache // ps
+    pool_pages = 1 + b * npp
+    key = jax.random.PRNGKey(2000)
+    k0, k1, k2, k3 = jax.random.split(key, 4)
+    km = jax.random.normal(k0, (pool_pages, hs_h, ps, d), jnp.bfloat16)
+    vm = jax.random.normal(k1, (pool_pages, hs_h, ps, d), jnp.bfloat16)
+    q = jax.random.normal(k2, (b, hs_h, 1, d), jnp.bfloat16)
+    perm = jax.random.permutation(
+        k3, jnp.arange(1, pool_pages, dtype=jnp.int32))
+    page_table = perm[: b * npp].reshape(b, npp)
+    lengths = jnp.where(
+        jnp.arange(b) % 2 == 0, cache, cache - ps // 2 - 1
+    ).astype(jnp.int32)
+    for kv in hs_kvs:
+        if kv == "int8":
+            def q8h(pages):
+                vals, scales = quantize_rows(
+                    pages.reshape(-1, d).astype(jnp.float32),
+                    kv_block)
+                return (vals.reshape(pages.shape),
+                        scales.reshape(*pages.shape[:-1], -1))
+
+            kp, ks = q8h(km)
+            vp, vs = q8h(vm)
+        else:
+            kp, vp = km, vm
+            ks = vs = None
+
+        def hs_kwargs(lo, hi):
+            # a shard's pool slice: heads [lo:hi) of every page (and
+            # of the per-block scales, which ride the head axis too)
+            return dict(
+                k_scales=None if ks is None else ks[:, lo:hi],
+                v_scales=None if vs is None else vs[:, lo:hi],
+                kv_block=kv_block)
+
+        # fp32 ground truth + the full-h single-call pallas output the
+        # shard concat must reproduce
+        with jax.default_matmul_precision("highest"):
+            if kv == "int8":
+                from apex_tpu.ops.attention_decode import (
+                    _dequant_pages,
+                )
+                kr = _dequant_pages(kp, ks, kv_block)
+                vr = _dequant_pages(vp, vs, kv_block)
+            else:
+                kr, vr = (kp.astype(jnp.float32),
+                          vp.astype(jnp.float32))
+            ref = jax.jit(
+                lambda q, kr, vr: paged_attention_reference(
+                    q, kr, vr, page_table, lengths))(
+                q.astype(jnp.float32), kr, vr)
+        out_full = jax.device_get(jax.jit(
+            lambda q, kp, vp: fmha_decode(
+                q, kp, vp, page_table, lengths,
+                implementation="pallas",
+                **hs_kwargs(0, hs_h)))(q, kp, vp))
+        for tp in hs_tps:
+            hl = hs_h // tp
+            shards_p, shards_x = [], []
+            for r in range(tp):
+                lo, hi = r * hl, (r + 1) * hl
+                kwr = hs_kwargs(lo, hi)
+                shards_p.append(jax.device_get(jax.jit(
+                    lambda q, kp, vp: fmha_decode(
+                        q, kp, vp, page_table, lengths,
+                        implementation="pallas", **kwr))(
+                    q[:, lo:hi], kp[:, lo:hi], vp[:, lo:hi])))
+                shards_x.append(jax.device_get(jax.jit(
+                    lambda q, kp, vp: fmha_decode(
+                        q, kp, vp, page_table, lengths,
+                        implementation="xla", **kwr))(
+                    q[:, lo:hi], kp[:, lo:hi], vp[:, lo:hi])))
+            cat_p = np.concatenate(shards_p, axis=1)
+            cat_x = np.concatenate(shards_x, axis=1)
+            kw0 = hs_kwargs(0, hl)
+
+            def fwd_t(impl):
+                return jax.jit(
+                    lambda q, kp, vp: jnp.sum(fmha_decode(
+                        q, kp, vp, page_table, lengths,
+                        implementation=impl, **kw0,
+                    ).astype(jnp.float32)))
+
+            iters = 10 if smoke else 50
+            p_ms = _time(fwd_t("pallas"), q[:, :hl], kp[:, :hl],
+                         vp[:, :hl], iters=iters)
+            x_ms = _time(fwd_t("xla"), q[:, :hl], kp[:, :hl],
+                         vp[:, :hl], iters=iters)
+            kv_bytes = 2 * b * npp * ps * hl * d * \
+                jnp.dtype(kp.dtype).itemsize
+            results.append({
+                "kernel": "fmha_decode",
+                "shape": [b, hl, 1, d],
+                "cache_len": cache,
+                "page_size": ps,
+                "dtype": kv,
+                "causal": True,
+                "auto_impl": "pallas",
+                "head_sharded": True,
+                "tp": tp,
+                "heads_global": hs_h,
+                "shard_vs_full_max_diff": _max_err(cat_p, out_full),
+                "fwd": {
+                    "pallas_ms": round(p_ms, 3),
+                    "xla_ms": round(x_ms, 3),
+                    "speedup": round(x_ms / p_ms, 2),
+                    "decode_gbs": round(
+                        kv_bytes / (p_ms * 1e-3) / 1e9, 1),
+                    "max_err_vs_fp32": _max_err(cat_p, ref),
+                    "xla_err_vs_fp32": _max_err(cat_x, ref),
                 },
             })
             print(json.dumps(results[-1]))

@@ -393,16 +393,24 @@ def train_zero3(vocab=None, layers=None, hidden=None, heads=None,
 #: The serving tier ladder (all head_dim=128, gelu MLP): chosen so the
 #: 16 GB verdict lands one width apart per tier — fp32 carries the 3B,
 #: bf16 the 8B, int8 the 13B and int4 the 30B class.  The 13B/30B rows
-#: are the tentpole claim: those tiers fit ONLY quantized.
+#: are the quantization claim: those tiers fit ONLY quantized.  The
+#: 70B row is the tensor-parallel claim: it exceeds 16 GB at EVERY
+#: width single-chip (int4 alone is ~36 GB of pool) and fits only
+#: when the quantized pool and head-sharded KV pool are split over a
+#: tp group — per-shard verdicts in the per-width ``tp`` sub-rows.
 SERVE_TIERS = (
     ("1B", dict(vocab=32768, layers=20, hidden=2048, heads=16)),
     ("3B", dict(vocab=32768, layers=32, hidden=2560, heads=20)),
     ("8B", dict(vocab=32768, layers=32, hidden=4096, heads=32)),
     ("13B", dict(vocab=32768, layers=40, hidden=5120, heads=40)),
     ("30B", dict(vocab=32768, layers=44, hidden=6144, heads=48)),
+    ("70B", dict(vocab=32768, layers=80, hidden=8192, heads=64)),
 )
 
 WEIGHT_WIDTHS = ("fp32", "bf16", "int8", "int4")
+
+#: tp degrees audited by default — matches the decode_fns warmup grid.
+SERVE_TP_DEGREES = (2, 4)
 
 
 def _tree_bytes(tpl) -> int:
@@ -415,13 +423,13 @@ def _tree_bytes(tpl) -> int:
         for l in jax.tree.leaves(tpl)))
 
 
-def _serve_weight_pool_bytes(model, width, block=128) -> int:
-    """Exact per-device bytes of the weight pool at ``width`` — from
-    ``eval_shape`` of the ACTUAL pool builder
-    (:func:`quantize_gpt_weights`), so scales, packing and the
-    full-precision embedding/norm leaves are counted as built, not
-    estimated.  Serving is dp-replicated: every device holds the whole
-    pool."""
+def _serve_pool_tree(model, width, block=128, tp=1):
+    """``eval_shape`` tree of the weight pool at ``width`` — from the
+    ACTUAL pool builder (:func:`quantize_gpt_weights`), so scales,
+    packing and the full-precision embedding/norm leaves are counted
+    as built, not estimated.  ``tp`` is threaded through so the int4
+    per-shard packing layout validates the same divisibility rules the
+    serving path enforces."""
     import jax
     import jax.numpy as jnp
 
@@ -431,7 +439,7 @@ def _serve_weight_pool_bytes(model, width, block=128) -> int:
 
     tpl = _param_template(model)
     if width == "fp32":
-        return _tree_bytes(tpl)
+        return tpl
     if width == "bf16":
         def cast(p):
             layers = dict(p["layers"])
@@ -442,9 +450,62 @@ def _serve_weight_pool_bytes(model, width, block=128) -> int:
                     layers[name] = leaf
             return {**p, "layers": layers}
 
-        return _tree_bytes(jax.eval_shape(cast, tpl))
-    return _tree_bytes(jax.eval_shape(
-        lambda p: quantize_gpt_weights(p, width, block), tpl))
+        return jax.eval_shape(cast, tpl)
+    return jax.eval_shape(
+        lambda p: quantize_gpt_weights(p, width, block, tp=tp), tpl)
+
+
+def _serve_weight_pool_bytes(model, width, block=128) -> int:
+    """Whole-pool bytes at ``width`` — what a dp-replicated (tp=1)
+    device holds."""
+    return _tree_bytes(_serve_pool_tree(model, width, block))
+
+
+def _serve_pool_specs(model, width, pool, tp):
+    """Partition specs matching ``pool``'s pytree — the same specs
+    :meth:`GPTModel.decode_fns` shards the served pool with (column
+    leaves split the stacked output dim, row leaves the contraction
+    dim, the vocab-parallel embedding its vocab rows; norms and row
+    biases replicated)."""
+    from apex_tpu.models.gpt import _quantized_layer_specs
+
+    specs = model.param_specs()
+    if width in ("int8", "int4"):
+        specs["layers"] = _quantized_layer_specs(
+            specs["layers"], pool["layers"], "tp", tp)
+    return specs
+
+
+def _serve_per_shard_bytes(pool, specs, tp) -> int:
+    """Bytes ONE tp shard holds of ``pool`` under ``specs``: each
+    leaf's bytes divided by ``tp`` per sharded mesh axis in its spec
+    (replicated leaves count in full).  Mirrors gpt.py's
+    ``_per_chip_param_bytes`` but works on ``eval_shape`` trees (no
+    ``nbytes`` on ShapeDtypeStruct) and needs no live mesh."""
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    def denom(spec):
+        d = 1
+        for entry in tuple(spec):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            d *= tp ** len(names)
+        return d
+
+    p_leaves = jax.tree.leaves(pool)
+    s_leaves = jax.tree.leaves(specs,
+                               is_leaf=lambda t: isinstance(t, P))
+    if len(p_leaves) != len(s_leaves):
+        raise ValueError(
+            f"pool/spec tree mismatch: {len(p_leaves)} pool leaves "
+            f"vs {len(s_leaves)} specs")
+    return int(sum(
+        (int(np.prod(x.shape)) if x.shape else 1)
+        * np.dtype(x.dtype).itemsize // denom(s)
+        for x, s in zip(p_leaves, s_leaves)))
 
 
 def _serve_kv_pool_bytes(layers, heads, head_dim, *, max_seqs,
@@ -467,14 +528,24 @@ def _serve_kv_pool_bytes(layers, heads, head_dim, *, max_seqs,
 
 
 def run_serve_audit(hbm_gb=DEFAULT_HBM_GB, max_seqs=4, context=1024,
-                    page_size=64, block=128) -> dict:
+                    page_size=64, block=128,
+                    tp=SERVE_TP_DEGREES) -> dict:
     """The --serve document: per-device decode-path bytes (weight pool
     + KV pool + decode activations) for every tier x weight width,
     and the largest tier that fits per width.  KV rides int8 (the
     shipping default since the paged-cache PR) with the fp32 pool
     bytes reported alongside; activations are a structural estimate
     (a handful of (max_seqs, ffn) rows plus the logits row — decode
-    activations are microscopic next to the pools)."""
+    activations are microscopic next to the pools).
+
+    Each width row additionally carries per-shard verdicts at every
+    tensor-parallel degree in ``tp``: the weight pool divides by the
+    decode_fns partition specs (quantized scales shard with their
+    blocks), the KV pool head-shards, and a combo that is indivisible
+    under the int4 per-shard packing rules reports ``fits_hbm: null``
+    with the builder's own error as the note.  Tiers that fit NO width
+    single-chip but fit some (width, tp) shard land in
+    ``fits_only_tensor_parallel`` — the 70B row is the headline."""
     import jax.numpy as jnp
 
     from apex_tpu.models import GPTConfig, GPTModel
@@ -519,7 +590,46 @@ def run_serve_audit(hbm_gb=DEFAULT_HBM_GB, max_seqs=4, context=1024,
             }
             if fits:
                 largest_fit[w] = name     # tiers ascend in size
+            tp_rows = {}
+            for t in tp or ():
+                if shape["heads"] % t:
+                    tp_rows[str(t)] = {
+                        "fits_hbm": None,
+                        "note": f"{shape['heads']} heads do not "
+                                f"divide tp={t}"}
+                    continue
+                try:
+                    pool = _serve_pool_tree(model, w, block, tp=t)
+                except ValueError as e:
+                    tp_rows[str(t)] = {"fits_hbm": None,
+                                       "note": str(e)}
+                    continue
+                specs = _serve_pool_specs(model, w, pool, t)
+                wps = _serve_per_shard_bytes(pool, specs, t)
+                kvs = kv["int8"] // t         # head-sharded pool
+                totals = wps + kvs + act
+                tp_rows[str(t)] = {
+                    "per_shard_weight_pool_bytes": wps,
+                    "per_shard_kv_pool_bytes": kvs,
+                    "per_shard_total_bytes": totals,
+                    "fits_hbm": bool(totals < hbm),
+                }
+            if tp_rows:
+                row["widths"][w]["tp"] = tp_rows
         tiers.append(row)
+    only_tp = []
+    for r in tiers:
+        if any(r["widths"][w]["fits_hbm"] for w in WEIGHT_WIDTHS):
+            continue
+        fits_at = [
+            {"width": w, "tp": int(t)}
+            for w in WEIGHT_WIDTHS
+            for t, c in sorted(r["widths"][w].get("tp", {}).items(),
+                               key=lambda kv_: int(kv_[0]))
+            if c.get("fits_hbm")
+        ]
+        if fits_at:
+            only_tp.append({"tier": r["tier"], "fits_at": fits_at})
     only_quant = [
         r["tier"] for r in tiers
         if not r["widths"]["fp32"]["fits_hbm"]
@@ -534,10 +644,12 @@ def run_serve_audit(hbm_gb=DEFAULT_HBM_GB, max_seqs=4, context=1024,
                 f"width (int8 KV)",
         "scenario": {"max_seqs": max_seqs, "context": context,
                      "page_size": page_size, "weight_block": block,
-                     "kv_dtype": "int8"},
+                     "kv_dtype": "int8",
+                     "tp_degrees": [int(t) for t in (tp or ())]},
         "hbm_limit_bytes": int(hbm),
         "tiers": tiers,
         "fits_only_quantized": only_quant,
+        "fits_only_tensor_parallel": only_tp,
     }
 
 
@@ -571,6 +683,10 @@ def main():
     ap.add_argument("--page-size", type=int, default=64)
     ap.add_argument("--weight-block", type=int, default=128,
                     help="--serve: quantization block size")
+    ap.add_argument("--tp", type=int, action="append", default=None,
+                    help="--serve: tensor-parallel degree for "
+                         "per-shard verdict rows (repeatable; "
+                         "default: 2 and 4)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     _force_virtual_devices(args.devices)
@@ -579,7 +695,8 @@ def main():
         doc = run_serve_audit(
             hbm_gb=args.hbm_gb, max_seqs=args.max_seqs,
             context=args.context, page_size=args.page_size,
-            block=args.weight_block)
+            block=args.weight_block,
+            tp=tuple(args.tp) if args.tp else SERVE_TP_DEGREES)
         root = os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))
         out_path = args.out or os.path.join(
@@ -590,6 +707,8 @@ def main():
         print(json.dumps({
             "metric": doc["metric"], "value": doc["value"],
             "fits_only_quantized": doc["fits_only_quantized"],
+            "fits_only_tensor_parallel":
+                doc["fits_only_tensor_parallel"],
             "tiers_gb": {
                 r["tier"]: {
                     w: round(r["widths"][w]["total_bytes"] / gb, 2)

@@ -136,6 +136,14 @@ def summarize_serving(records: List[dict]) -> Optional[Dict[str, Any]]:
         if wdts:
             out["weight_dtype"] = (sorted(wdts)[0] if len(wdts) == 1
                                    else sorted(wdts))
+        # the tensor-parallel degree rides the decode spans exactly
+        # like weight_dtype; weight_bytes is already PER CHIP (gpt.py
+        # stamps each chip's own pool slice), so the GB/s above is the
+        # per-chip stream without further division
+        tps = {int(r["tp"]) for r in decode if r.get("tp")}
+        if tps:
+            out["tp"] = (sorted(tps)[0] if len(tps) == 1
+                         else sorted(tps))
         if wgbs:
             out["weight_stream_gbs"] = _stats(wgbs)
         if itl:
@@ -428,7 +436,7 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
                       "gbs",
                       # serving span / request / prefix-cache fields
                       "span", "steps", "slots", "tokens", "dur_s",
-                      "weight_dtype", "weight_bytes",
+                      "weight_dtype", "weight_bytes", "tp",
                       "uid", "slot", "reason", "new_tokens",
                       "ttft_s", "chunk", "start", "matched_tokens",
                       "shared_pages", "tokens_skipped", "copied",
@@ -527,9 +535,13 @@ def format_report(summary: Dict[str, Any]) -> str:
                 wd = sv["weight_dtype"]
                 row += (wd if isinstance(wd, str) else "/".join(wd))
                 row += " weights"
+            if "tp" in sv:
+                t = sv["tp"]
+                row += (f", tp={t}" if isinstance(t, int)
+                        else ", tp=" + "/".join(str(x) for x in t))
             if g:
-                row += (f", mean {g['mean']:.4g} GB/s  "
-                        f"best {g['best']:.4g} GB/s")
+                row += (f", mean {g['mean']:.4g} GB/s/chip  "
+                        f"best {g['best']:.4g} GB/s/chip")
             lines.append(row)
         if "inter_token_latency_ms" in sv:
             i = sv["inter_token_latency_ms"]
