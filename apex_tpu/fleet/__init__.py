@@ -18,6 +18,12 @@ router.  Two modules, one concern each:
   (prompt, seed, committed tokens) survives its replica, so a killed
   replica's work re-admits elsewhere with emitted tokens replayed as
   prompt suffix — token-identical continuations, zero lost requests.
+- :mod:`~apex_tpu.fleet.journal` — the durable, CRC-checked
+  write-ahead :class:`RequestJournal` (O_APPEND JSONL) and
+  :func:`recover_journal`: the same replayable state persisted to
+  disk, so full-PROCESS death recovers via
+  ``FleetRouter.resume_from_journal`` — completed streams kept,
+  in-flight requests re-admitted token-identically.
 
 ``tools/load_gen.py`` generates deterministic bursty traces and
 replays them through a router; docs/serving.md ("Fleet tier") is the
@@ -28,8 +34,10 @@ the routing win and the failover contract.
 _LAZY_ATTRS = {
     "router": "apex_tpu.fleet.router",
     "failover": "apex_tpu.fleet.failover",
+    "journal": "apex_tpu.fleet.journal",
     "SLOClass": "apex_tpu.fleet.router",
     "FleetPolicy": "apex_tpu.fleet.router",
+    "BrownoutPolicy": "apex_tpu.fleet.router",
     "Replica": "apex_tpu.fleet.router",
     "FleetRouter": "apex_tpu.fleet.router",
     "FleetCompletion": "apex_tpu.fleet.router",
@@ -38,6 +46,9 @@ _LAZY_ATTRS = {
     "LogEntry": "apex_tpu.fleet.failover",
     "RequestLog": "apex_tpu.fleet.failover",
     "resume_request": "apex_tpu.fleet.failover",
+    "RequestJournal": "apex_tpu.fleet.journal",
+    "JournalRecovery": "apex_tpu.fleet.journal",
+    "recover_journal": "apex_tpu.fleet.journal",
 }
 
 __all__ = sorted(_LAZY_ATTRS)
@@ -48,7 +59,7 @@ def __getattr__(name):
         import importlib
 
         mod = importlib.import_module(_LAZY_ATTRS[name])
-        val = (mod if name in ("router", "failover")
+        val = (mod if name in ("router", "failover", "journal")
                else getattr(mod, name))
         globals()[name] = val
         return val

@@ -69,6 +69,12 @@ class LogEntry:
     #: accurate) — the fleet-level, arrival-anchored TTFT numerator
     t_first: Optional[float] = None
     t_done: Optional[float] = None
+    #: absolute deadline (router clock) and the relative amount it was
+    #: armed with — the router re-arms ``deadline_rel`` on a retry or
+    #: a journal resume; None = no deadline (the default)
+    deadline: Optional[float] = None
+    deadline_rel: Optional[float] = None
+    deadline_retries: int = 0
 
 
 class RequestLog:
@@ -158,6 +164,12 @@ class RequestLog:
         e.replayed = list(e.emitted)
         e.replica = replica
         e.replays += 1
+
+    def entries(self):
+        """Every entry, admission order — what the durable journal
+        (:class:`~apex_tpu.fleet.journal.RequestJournal.sync`) and the
+        deadline sweep iterate."""
+        return list(self._entries.values())
 
     def inflight_on(self, replica: str) -> List[LogEntry]:
         """Entries the named replica holds that have not completed —

@@ -25,9 +25,35 @@ I/O seams ``checkpoint._open`` / ``checkpoint._replace``):
 numeric faults:
   :func:`poison_tree` — NaN/Inf-poison one leaf of a gradient pytree
 
+serving faults (context managers over a
+:class:`~apex_tpu.serving.serve.ContinuousBatcher` or its module
+seams — the fleet chaos surface, ``tools/chaos_drill.py``):
+  :func:`stalled_pump`      — harvest windows sleep before running
+                              (the wedged-replica signal
+                              ``FleetPolicy.pump_timeout_s``
+                              quarantines on)
+  :func:`hanging_harvests`  — the Nth harvest resolve
+                              (``serve._device_get``) sleeps: a hung
+                              device→host sync
+  :func:`nonfinite_logits`  — the Nth decode/verify step raises
+                              ``FloatingPointError`` BEFORE dispatch
+                              (carry/pools untouched, so a retry or
+                              migration serves consistent state)
+  :func:`failing_windows`   — the Nth harvest window raises: the
+                              generic repeated-fault event the
+                              router's consecutive-fault quarantine
+                              counts
+  :func:`exhaust_pool`      — steal the allocator's free pages
+                              out-of-band: admission backpressure,
+                              page-pressure brownout
+
 All injection is count-based and single-process deterministic — no
-randomness, no timing dependence — so a failing resilience test replays
-identically.
+randomness, no timing dependence (the sleeps have deterministic
+PLACEMENT; pair them with a fleet policy whose timeout they exceed) —
+so a failing resilience test replays identically.  SIGKILL-mid-serve,
+the one fault no in-process seam can fake, lives in
+``tools/chaos_drill.py``'s subprocess drill (the ``fault_drill.py``
+pattern).
 """
 
 from __future__ import annotations
@@ -36,6 +62,7 @@ import contextlib
 import os
 import signal
 import threading
+import time
 from typing import Any, Iterator, Optional
 
 import numpy as np
@@ -49,6 +76,11 @@ __all__ = [
     "sigterm_on_write",
     "poison_tree",
     "InjectedIOError",
+    "stalled_pump",
+    "hanging_harvests",
+    "nonfinite_logits",
+    "failing_windows",
+    "exhaust_pool",
 ]
 
 
@@ -240,3 +272,154 @@ def poison_tree(tree: Any, leaf_index: int = 0, element: int = 0,
     flat = list(flat)
     flat[pos] = arr
     return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+# ---------------------------------------------------------------- serving
+@contextlib.contextmanager
+def stalled_pump(batcher: Any, *, stall_s: float,
+                 after_windows: int = 0,
+                 forever: bool = True) -> Iterator[list]:
+    """Within the block, ``batcher``'s harvest windows sleep ``stall_s``
+    seconds before running — the wedged-replica signal (a hung collective,
+    a runaway host callback) that ``FleetPolicy.pump_timeout_s``
+    quarantines on.  The first ``after_windows`` windows run clean;
+    with ``forever=False`` only one window stalls.  Yields a
+    single-element list counting injected stalls.
+
+    Patches ``_decode_window`` only — it is the single harvest entry
+    point from ``pump()`` and itself dispatches to the speculative
+    window, so one patch covers both paths without double-counting."""
+    orig = batcher._decode_window
+    seen = [0]
+    stalls = [0]
+
+    def slow_window(*a, **k):
+        seen[0] += 1
+        if seen[0] > after_windows and (forever or stalls[0] < 1):
+            stalls[0] += 1
+            time.sleep(stall_s)
+        return orig(*a, **k)
+
+    batcher._decode_window = slow_window
+    try:
+        yield stalls
+    finally:
+        batcher._decode_window = orig
+
+
+@contextlib.contextmanager
+def hanging_harvests(*, nth: int = 1, hang_s: float = 0.05,
+                     forever: bool = False) -> Iterator[list]:
+    """Within the block, the ``nth`` harvest resolve — the
+    ``serve._device_get`` device→host sync every window ends on —
+    sleeps ``hang_s`` seconds first (every resolve from the ``nth`` on
+    with ``forever=True``): a hung device fetch.  Module-level seam, so
+    it hits EVERY batcher — pair with ``FleetPolicy.pump_timeout_s`` to
+    watch the slowest replica get quarantined.  Yields a single-element
+    list counting resolves seen."""
+    from apex_tpu.serving import serve
+
+    orig = serve._device_get
+    count = [0]
+
+    def hanging_get(x):
+        count[0] += 1
+        if count[0] == nth or (forever and count[0] >= nth):
+            time.sleep(hang_s)
+        return orig(x)
+
+    serve._device_get = hanging_get
+    try:
+        yield count
+    finally:
+        serve._device_get = orig
+
+
+@contextlib.contextmanager
+def nonfinite_logits(batcher: Any, *, nth: int = 1,
+                     forever: bool = False) -> Iterator[list]:
+    """Within the block, ``batcher``'s ``nth`` decode/verify dispatch
+    raises ``FloatingPointError`` BEFORE launching (every dispatch from
+    the ``nth`` on with ``forever=True``) — the numerics blow-up a
+    replica surfaces as a pump exception.  Raising before dispatch
+    leaves carry and KV pools at the last harvested state, so the
+    router's migration path re-serves every slot from consistent
+    committed prefixes.  Yields a single-element list counting
+    dispatches seen."""
+    orig_decode = batcher.decode_fn
+    orig_spec = batcher.spec_fn
+    count = [0]
+
+    def _gate():
+        count[0] += 1
+        if count[0] == nth or (forever and count[0] >= nth):
+            raise FloatingPointError(
+                f"injected nonfinite logits (resilience fault seam, "
+                f"dispatch #{count[0]})")
+
+    def poisoned_decode(*a, **k):
+        _gate()
+        return orig_decode(*a, **k)
+
+    batcher.decode_fn = poisoned_decode
+    if orig_spec is not None:
+        def poisoned_spec(*a, **k):
+            _gate()
+            return orig_spec(*a, **k)
+        batcher.spec_fn = poisoned_spec
+    try:
+        yield count
+    finally:
+        batcher.decode_fn = orig_decode
+        batcher.spec_fn = orig_spec
+
+
+@contextlib.contextmanager
+def failing_windows(batcher: Any, *, nth: int = 1, count: int = 1,
+                    error: type = RuntimeError) -> Iterator[list]:
+    """Within the block, ``batcher``'s harvest windows ``nth`` through
+    ``nth + count - 1`` raise ``error`` before running — the generic
+    repeated-fault signal the router's consecutive-fault quarantine
+    (``FleetPolicy.max_replica_faults``) counts.  One window = one
+    ``pump()`` call's harvest, so ``count=1`` is a transient blip (the
+    replica recovers, its consecutive counter resets) and
+    ``count >= max_replica_faults`` forces quarantine.  Yields a
+    single-element list counting windows seen.  (``_decode_window``
+    patch only — the single harvest entry point, see
+    :func:`stalled_pump`.)"""
+    orig = batcher._decode_window
+
+    seen = [0]
+
+    def flaky_window(*a, **k):
+        seen[0] += 1
+        if nth <= seen[0] < nth + count:
+            raise error(
+                f"injected window failure (resilience fault seam, "
+                f"window #{seen[0]})")
+        return orig(*a, **k)
+
+    batcher._decode_window = flaky_window
+    try:
+        yield seen
+    finally:
+        batcher._decode_window = orig
+
+
+@contextlib.contextmanager
+def exhaust_pool(cache: Any, *, leave_free: int = 0) -> Iterator[list]:
+    """Within the block, steal all but ``leave_free`` of ``cache``'s
+    free KV pages out-of-band (``cache`` is a ``PagedKVCache`` or
+    anything exposing ``.allocator``) — admission sees a pool under
+    memory pressure, which is what drives the router's page-pressure
+    brownout rungs and ``too_large``/``queue_full`` backpressure.
+    All-or-nothing like any allocation; pages are returned on exit.
+    Yields the list of stolen page ids."""
+    alloc = getattr(cache, "allocator", cache)
+    n = max(0, alloc.num_free - int(leave_free))
+    pages = alloc.alloc(n) if n else []
+    try:
+        yield pages
+    finally:
+        if pages:
+            alloc.free(pages)

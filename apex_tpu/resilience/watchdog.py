@@ -187,12 +187,17 @@ class Watchdog:
         return False
 
     # ---------------------------------------------------------- heartbeat
-    def beat(self, step: Optional[int] = None) -> None:
+    def beat(self, step: Optional[int] = None,
+             extra: Optional[dict] = None) -> None:
         """Mark the loop alive (call once per step, *after* device work
         lands — beat before ``block_until_ready`` and a hung collective
         looks healthy).  With a heartbeat file configured, mirrors
         liveness there (throttled, atomic tmp+rename) so out-of-process
-        observers see ``{"at", "pid", "step"}``."""
+        observers see ``{"at", "pid", "step"}`` plus any ``extra``
+        fields — the serving fleet passes
+        ``{"replica", "serving_step", "live_slots"}`` per pump so
+        ``tools/tpu_watch.py`` can NAME the stalled replica, not just
+        report a stale timestamp."""
         self._last_beat = time.monotonic()
         self._tripped = False
         hb = self.heartbeat_file
@@ -205,6 +210,8 @@ class Watchdog:
         rec = {"at": now, "pid": os.getpid()}
         if step is not None:
             rec["step"] = int(step)
+        if extra:
+            rec.update(extra)
         tmp = f"{hb}.{os.getpid()}.tmp"
         try:
             with open(tmp, "w") as f:

@@ -113,6 +113,12 @@ __all__ = ["Request", "Completion", "ContinuousBatcher", "init_carry"]
 # backends; the copy is still correct.)
 _copy_pages_jit = jax.jit(copy_pages, donate_argnums=0)
 
+#: the harvest-resolve seam: both windows pull device results through
+#: this module alias, so the resilience tier can inject a hanging
+#: harvest (``resilience.faults.hanging_harvests``) at the exact
+#: host-sync boundary a real wedged device manifests at
+_device_get = jax.device_get
+
 
 @dataclasses.dataclass
 class Request:
@@ -320,6 +326,18 @@ class ContinuousBatcher:
         self.tp = getattr(decode_fn, "tp", None)
         self.prefill_chunk = (None if prefill_chunk is None
                               else int(prefill_chunk))
+        #: brownout levers (the fleet's degradation ladder drives
+        #: both — :class:`apex_tpu.fleet.router.BrownoutPolicy`):
+        #: ``speculation_enabled=False`` falls back to plain one-token
+        #: windows without touching the compiled steps (spec_fn stays
+        #: warm for recovery); ``chunk_throttle=N`` runs an
+        #: interleaved prefill chunk on every Nth window iteration
+        #: instead of every one (N=1 = no throttle).  Both change
+        #: SCHEDULING only — streams stay token-identical, because
+        #: the key schedule folds context length, not step timing.
+        self.speculation_enabled = True
+        self.chunk_throttle = 1
+        self._chunk_tick = 0
         self.prefix_cache = bool(prefix_cache)
         self.measure_stall = bool(measure_stall)
         self.cache = cache
@@ -680,16 +698,18 @@ class ContinuousBatcher:
         for _ in range(self.harvest_every):
             did_chunk = False
             if self._prefilling:
-                chunk_s += self._prefill_step(
-                    next(iter(self._prefilling)))
-                did_chunk = True
+                self._chunk_tick += 1
+                if self._chunk_tick % max(1, self.chunk_throttle) == 0:
+                    chunk_s += self._prefill_step(
+                        next(iter(self._prefilling)))
+                    did_chunk = True
             # resolve pending admit-time first tokens NOW: the draft
             # source needs the full committed context, and this window
             # syncs per verify step anyway
             if self._first_tok:
                 firsts = {s: self._first_tok.pop(s)
                           for s in list(self._first_tok)}
-                self._absorb_firsts(jax.device_get(firsts),
+                self._absorb_firsts(_device_get(firsts),
                                     time.perf_counter())
             live = [(s, m) for s, m in self._meta.items()
                     if m["finished"] is None]
@@ -721,7 +741,7 @@ class ContinuousBatcher:
                 self.pools, self.carry, out, n_commit = self.spec_fn(
                     self.pools, self.carry, page_table,
                     drafts, dlens)
-            out_h, nc_h, done_h = jax.device_get(
+            out_h, nc_h, done_h = _device_get(
                 (out, n_commit, self.carry["done"]))
             self.steps += 1
             steps += 1
@@ -773,7 +793,7 @@ class ContinuousBatcher:
         t_h = time.perf_counter()
         self.windows += 1
         if done_h is None:
-            done_h = jax.device_get(self.carry["done"])
+            done_h = _device_get(self.carry["done"])
         self._event(
             "span", span="decode", steps=steps,
             slots=len(self._meta), tokens=kept,
@@ -783,7 +803,7 @@ class ContinuousBatcher:
         self._retire(done_h, t_h)
 
     def _decode_window(self) -> None:
-        if self.spec_fn is not None:
+        if self.spec_fn is not None and self.speculation_enabled:
             return self._spec_window()
         base = self.steps
         page_table = jnp.asarray(self.cache.page_table)
@@ -791,12 +811,15 @@ class ContinuousBatcher:
         t0 = time.perf_counter()
         chunk_s = 0.0          # interleaved prefill time, kept OUT of
         for _ in range(self.harvest_every):  # the decode span's dur_s
-            # the step's token budget: at most ONE prefill chunk ...
+            # the step's token budget: at most ONE prefill chunk
+            # (every chunk_throttle-th iteration under brownout) ...
             did_chunk = False
             if self._prefilling:
-                chunk_s += self._prefill_step(
-                    next(iter(self._prefilling)))
-                did_chunk = True
+                self._chunk_tick += 1
+                if self._chunk_tick % max(1, self.chunk_throttle) == 0:
+                    chunk_s += self._prefill_step(
+                        next(iter(self._prefilling)))
+                    did_chunk = True
             # ... plus one decode token for every live slot
             if self._window_budget(base) > 0:
                 with phase("decode"):
@@ -811,7 +834,7 @@ class ContinuousBatcher:
         steps = len(window)
         firsts = {s: self._first_tok.pop(s) for s in list(self._first_tok)}
         stacked = jnp.stack(window) if window else None
-        harvested, firsts_h, done_h = jax.device_get(
+        harvested, firsts_h, done_h = _device_get(
             (stacked, firsts, self.carry["done"]))
         t_h = time.perf_counter()
         self.windows += 1

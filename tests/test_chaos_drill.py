@@ -1,0 +1,70 @@
+"""Slow-tier serving chaos drills (``tools/chaos_drill.py``).
+
+Two legs, both scored by the drill's own zero-loss / token-identity
+ledger and both asserting a PASSED stdout line plus exit 0:
+
+- the in-process chaos matrix — replica kill, quarantine-by-faults,
+  transient fault, brownout pressure, deadline/hedge scenario, and the
+  < 2% journal-overhead gate, each compared token-for-token against an
+  unfaulted reference replay;
+- the SIGKILL restart drill — a real ``kill -9`` mid-serve (in-process
+  mocks don't survive one), then a next life that restores the
+  checkpoint seam, re-derives the quantized pool bit-identically,
+  replays the durable journal and resumes every in-flight request
+  token-identically.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(extra):
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "chaos_drill.py")]
+        + extra,
+        capture_output=True, text=True, timeout=560,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PYTHONPATH=_REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")),
+    )
+
+
+def _ledger(stdout):
+    for line in stdout.splitlines():
+        if line.startswith("CHAOS "):
+            return json.loads(line[len("CHAOS "):])
+    raise AssertionError(f"no CHAOS ledger line in:\n{stdout}")
+
+
+def test_chaos_matrix_in_process(tmp_path):
+    proc = _run([])
+    assert proc.returncode == 0, (
+        f"chaos matrix failed:\n{proc.stdout}\n{proc.stderr}")
+    assert "chaos drill PASSED" in proc.stdout
+    led = _ledger(proc.stdout)
+    assert led["zero_loss"] and led["token_identical"]
+    sc = led["scenarios"]
+    assert sc["nonfinite_quarantine"]["quarantined"] == "faults"
+    assert sc["brownout"]["transitions"] >= 1
+    assert sc["deadline_hedge"]["deadline_misses"] >= 1
+    assert sc["deadline_hedge"]["hedges"] >= 1
+    assert sc["journal_overhead"]["frac"] < 0.02
+
+
+def test_chaos_restart_drill_sigkill_mid_serve(tmp_path):
+    proc = _run(["--subprocess", "--root", str(tmp_path / "drill")])
+    assert proc.returncode == 0, (
+        f"restart drill failed:\n{proc.stdout}\n{proc.stderr}")
+    assert "chaos drill PASSED" in proc.stdout
+    led = _ledger(proc.stdout)
+    assert led["zero_loss"] and led["token_identical"]
+    assert led["replayed"]["resumed"] >= 1
+    assert led["replayed"]["corrupt"] == 0

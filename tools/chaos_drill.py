@@ -1,0 +1,561 @@
+#!/usr/bin/env python
+"""Chaos drill: scripted fault schedules against a serving fleet, scored
+as a zero-loss / token-identity / deadline ledger.
+
+The fleet's fault-tolerance tier makes exactly three promises, and this
+drill is where all of them are rehearsed together instead of one seam
+at a time:
+
+1. **zero loss** — every admitted request ends in a completion or a
+   clean per-request terminal (``deadline``), never a hang and never a
+   silently dropped uid;
+2. **token identity** — every completed stream is byte-identical to an
+   unfaulted reference run of the same trace (deadline terminals are
+   committed PREFIXES of the reference), because replay/migration/
+   hedging all re-derive the same stream from the absolute-position
+   key schedule;
+3. **bounded overhead** — the durable request journal stays under 2%
+   of serving step time (batched appends, no per-token host syncs),
+   self-measured from the journal's own write clock.
+
+Default mode runs the in-process chaos matrix on a tiny deterministic
+GPT fleet: a clean reference replay of a ``tools/load_gen.py`` trace,
+then the same trace under a schedule of injected faults (replica kill
+mid-serve, repeated non-finite faults to quarantine, a transient
+single-window fault, brownout queue pressure) plus a scripted
+deadline/hedge scenario on an injectable clock, and finally a
+journaled replay scored for overhead.  Ledger to stdout as one
+``CHAOS {...}`` JSON line; exit 0 iff every promise held.
+
+``--subprocess`` runs the restart drill across a REAL process
+boundary, ``tools/fault_drill.py``-style: a child serves with a
+durable journal and is SIGKILLed mid-serve (no in-process mocking
+survives one); its next life restores params from the checkpoint
+seam, re-derives the quantized weight pool (asserted bit-identical to
+the pool the first life served), replays the journal and resumes every
+in-flight request — the drill passes iff the stitched streams match a
+never-killed reference child token-for-token with zero losses.
+
+Standalone::
+
+    python tools/chaos_drill.py                 # in-process matrix
+    python tools/chaos_drill.py --subprocess    # SIGKILL restart drill
+
+or via the slow test tier (``tests/test_chaos_drill.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _log(msg: str) -> None:
+    print(f"[chaos-drill] {msg}", flush=True)
+
+
+# --------------------------------------------------------------- world
+def _mk_world(params_tree=None):
+    """One tiny deterministic GPT serving world (CPU-friendly shape).
+    Returns ``(model, params, ccfg, fns, maxp)``; ``params_tree``
+    overrides the seeded init (the restart drill's restored/quantized
+    pools enter here)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.serving.kv_cache import KVCacheConfig
+    from apex_tpu.transformer import parallel_state
+
+    if parallel_state.model_parallel_is_initialized():
+        parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        devices=jax.devices()[:1])
+    model = GPTModel(GPTConfig(
+        vocab_size=64, num_layers=2, hidden_size=32,
+        num_attention_heads=4, max_position_embeddings=96,
+        compute_dtype=jnp.float32, remat=False, attention_impl="xla"))
+    params = (model.init(jax.random.PRNGKey(7))
+              if params_tree is None else params_tree)
+    page, new, maxp = 4, 12, 48
+    pps = -(-(maxp + new) // page)
+    ccfg = KVCacheConfig(
+        num_layers=2, num_heads=4, head_dim=8,
+        num_pages=1 + 4 * pps, page_size=page, max_seqs=2,
+        pages_per_seq=pps, dtype=jnp.float32)
+    fns = model.decode_fns(params, mesh, ccfg, max_prompt_len=maxp,
+                           prefill_chunk=4)
+    return model, params, ccfg, fns, maxp
+
+
+def _mk_replicas(ccfg, fns, maxp, n=2):
+    from apex_tpu.fleet import Replica
+    from apex_tpu.serving.kv_cache import PagedKVCache, init_pools
+    from apex_tpu.serving.serve import ContinuousBatcher
+
+    return [
+        Replica(f"r{i}", ContinuousBatcher(
+            fns.prefill, fns.decode, PagedKVCache(ccfg),
+            init_pools(ccfg), max_prompt_len=maxp, harvest_every=2,
+            chunk_fn=fns.chunk, prefill_chunk=4, prefix_cache=True))
+        for i in range(n)
+    ]
+
+
+def _mk_trace(n=24, seed=11):
+    from tools.load_gen import make_trace
+
+    return make_trace(
+        n_requests=n, seed=seed, vocab_size=64, mean_gap=0.5,
+        burstiness=4.0, prompt_len=(10, 26), new_tokens=(4, 8),
+        interactive_frac=0.5, cohorts=2, cohort_frac=0.7,
+        prefix_len=8)
+
+
+def _streams(router):
+    return {u: list(c.tokens) for u, c in router.completions.items()}
+
+
+def _check_identity(name, streams, ref, *, allow_prefix=()):
+    """Every stream must equal the reference (or be a committed prefix
+    for uids in ``allow_prefix``).  Returns a list of violations."""
+    bad = []
+    for uid, toks in streams.items():
+        want = ref.get(uid)
+        if want is None:
+            bad.append(f"{name}: {uid} has no reference stream")
+        elif toks != want and not (
+                uid in allow_prefix and toks == want[:len(toks)]):
+            bad.append(f"{name}: {uid} diverged "
+                       f"(got {len(toks)} toks, want {len(want)})")
+    return bad
+
+
+# ------------------------------------------------------- in-process mode
+def run_matrix() -> int:
+    from apex_tpu.fleet import (
+        BrownoutPolicy,
+        FleetPolicy,
+        FleetRouter,
+        RequestJournal,
+        SLOClass,
+    )
+    from apex_tpu.resilience import faults
+    from apex_tpu.serving.serve import Request
+    from tools.load_gen import replay, summarize_trace
+
+    import tempfile
+
+    model, params, ccfg, fns, maxp = _mk_world()
+    trace = _mk_trace()
+    n_req = len(trace)
+    problems = []
+    ledger = {"requests": n_req, "scenarios": {}}
+
+    def fleet(policy=None, **kw):
+        return FleetRouter(_mk_replicas(ccfg, fns, maxp), policy, **kw)
+
+    # ---- reference: the unfaulted truth ----------------------------
+    t0 = time.perf_counter()
+    ref_router = fleet()
+    recs = replay(ref_router, trace)
+    ref_wall = time.perf_counter() - t0
+    ref = _streams(ref_router)
+    s = summarize_trace(recs)
+    if s["lost"] or s["completed"] != n_req:
+        problems.append(f"reference run lost requests: {s}")
+    ledger["scenarios"]["reference"] = {
+        "completed": s["completed"], "wall_s": round(ref_wall, 3)}
+    _log(f"reference: {s['completed']}/{n_req} completed "
+         f"in {ref_wall:.2f}s")
+
+    # ---- scenario: replica killed mid-serve ------------------------
+    r = fleet()
+    r.replicas[0].fail_after(2)
+    s = summarize_trace(replay(r, trace))
+    problems += _check_identity("kill", _streams(r), ref)
+    if s["lost"] or s["completed"] != n_req:
+        problems.append(f"kill scenario lost requests: {s}")
+    if s["migrated"] < 1:
+        problems.append("kill scenario migrated nothing")
+    ledger["scenarios"]["replica_kill"] = {
+        "completed": s["completed"], "migrated": s["migrated"]}
+    _log(f"replica_kill: {s['completed']}/{n_req} completed, "
+         f"{s['migrated']} migrated")
+
+    # ---- scenario: repeated non-finite faults -> quarantine --------
+    from apex_tpu.fleet import FleetPolicy as _FP
+
+    r = fleet(_FP(max_replica_faults=2))
+    with faults.nonfinite_logits(r.replicas[0].batcher, nth=3,
+                                 forever=True):
+        s = summarize_trace(replay(r, trace))
+    problems += _check_identity("quarantine", _streams(r), ref)
+    if s["lost"] or s["completed"] != n_req:
+        problems.append(f"quarantine scenario lost requests: {s}")
+    if r.replicas[0].quarantined != "faults":
+        problems.append("faulting replica was not quarantined")
+    ledger["scenarios"]["nonfinite_quarantine"] = {
+        "completed": s["completed"],
+        "quarantined": r.replicas[0].quarantined,
+        "replica_faults": r.stats["replica_faults"]}
+    _log(f"nonfinite_quarantine: {s['completed']}/{n_req} completed, "
+         f"r0 quarantined={r.replicas[0].quarantined}")
+
+    # ---- scenario: one transient fault heals without quarantine ----
+    r = fleet()
+    with faults.failing_windows(r.replicas[0].batcher, nth=2, count=1):
+        s = summarize_trace(replay(r, trace))
+    problems += _check_identity("transient", _streams(r), ref)
+    if s["lost"] or s["completed"] != n_req:
+        problems.append(f"transient scenario lost requests: {s}")
+    if r.stats["quarantined"]:
+        problems.append("transient fault wrongly quarantined a replica")
+    ledger["scenarios"]["transient_fault"] = {
+        "completed": s["completed"],
+        "replica_faults": r.stats["replica_faults"]}
+    _log(f"transient_fault: {s['completed']}/{n_req} completed, "
+         f"no quarantine")
+
+    # ---- scenario: brownout under queue pressure -------------------
+    r = fleet(FleetPolicy(brownout=BrownoutPolicy(
+        page_frac=(0.0, 0.0, 0.0), queue_depth=(3, 5, 8))))
+    s = summarize_trace(replay(r, trace))
+    problems += _check_identity("brownout", _streams(r), ref)
+    if s["lost"]:
+        problems.append(f"brownout scenario lost requests: {s}")
+    if s["completed"] + s["rejected"] != n_req:
+        problems.append(f"brownout ledger does not balance: {s}")
+    if r.stats["brownout_transitions"] < 1:
+        problems.append("queue pressure never tripped the brownout "
+                        "ladder")
+    ledger["scenarios"]["brownout"] = {
+        "completed": s["completed"], "rejected": s["rejected"],
+        "transitions": r.stats["brownout_transitions"]}
+    _log(f"brownout: {s['completed']} completed + {s['rejected']} shed, "
+         f"{r.stats['brownout_transitions']} transitions")
+
+    # ---- scenario: deadlines + hedging on an injectable clock ------
+    # admission first: with a 1 s/step floor, a 12-token request can
+    # never meet a 3 s deadline — it must be rejected with the
+    # distinct reason, not admitted and doomed
+    ra = FleetRouter(_mk_replicas(ccfg, fns, maxp), FleetPolicy(
+        classes=(SLOClass("interactive", 0, deadline_s=3.0),
+                 SLOClass("batch", 1)),
+        step_floor_s=1.0))
+    if ra.submit(Request(uid="x", prompt=[1] * 8, max_new_tokens=12,
+                         seed=3)):
+        problems.append("unmeetable deadline was admitted")
+    if ra.rejected.get("x") != "deadline_unmeetable":
+        problems.append(f"wrong rejection reason for unmeetable "
+                        f"deadline: {ra.rejected.get('x')}")
+    # then the miss/retry/hedge run on a tick clock (no step floor, so
+    # admission passes; 6 requests onto 4 slots queue past deadline)
+    clk = [0.0]
+    policy = FleetPolicy(
+        classes=(SLOClass("interactive", 0, deadline_s=3.0,
+                          max_retries=8, hedge_after_s=2.0),
+                 SLOClass("batch", 1, deadline_s=40.0)))
+    r = FleetRouter(_mk_replicas(ccfg, fns, maxp), policy,
+                    clock=lambda: clk[0])
+    dreqs = [it.request for it in trace[:6]]
+    for q in dreqs:
+        r.submit(q, "interactive")
+    while r.pending:
+        r.step()
+        clk[0] += 1.0
+        if clk[0] > 300:
+            problems.append("deadline/hedge scenario livelocked")
+            break
+    dref = {u: ref[u] for u in (q.uid for q in dreqs)}
+    dead = [u for u, c in r.completions.items()
+            if c.reason == "deadline"]
+    problems += _check_identity("deadline", _streams(r), dref,
+                                allow_prefix=set(dead))
+    if len(r.completions) != len(dreqs):
+        problems.append("deadline scenario lost requests")
+    ledger["scenarios"]["deadline_hedge"] = {
+        "completed": len(r.completions),
+        "deadline_misses": r.stats["deadline_misses"],
+        "retries": r.stats["deadline_retries"],
+        "terminal_deadline": len(dead),
+        "hedges": r.stats["hedges"],
+        "hedge_wins": r.stats["hedge_wins"],
+        "hedge_losses": r.stats["hedge_losses"],
+        "rejected_unmeetable": 1}
+    _log(f"deadline_hedge: {r.stats['deadline_misses']} misses, "
+         f"{r.stats['deadline_retries']} retries, "
+         f"{r.stats['hedges']} hedges ({len(dead)} terminal)")
+
+    # ---- journal overhead: < 2% of serving step time ---------------
+    with tempfile.TemporaryDirectory() as td:
+        journal = RequestJournal(os.path.join(td, "journal.jsonl"))
+        r = fleet(journal=journal)
+        t0 = time.perf_counter()
+        s = summarize_trace(replay(r, trace))
+        wall = time.perf_counter() - t0
+        frac = journal.stats["write_s"] / max(wall, 1e-9)
+        problems += _check_identity("journaled", _streams(r), ref)
+        if s["lost"] or s["completed"] != n_req:
+            problems.append(f"journaled run lost requests: {s}")
+        if frac >= 0.02:
+            problems.append(
+                f"journal overhead {frac:.2%} >= 2% of serving time")
+        ledger["scenarios"]["journal_overhead"] = {
+            "write_s": round(journal.stats["write_s"], 5),
+            "wall_s": round(wall, 3),
+            "frac": round(frac, 5),
+            "appends": journal.stats["appends"],
+            "records": journal.stats["records"]}
+        journal.close()
+    _log(f"journal overhead: {frac:.3%} of serving wall "
+         f"({journal.stats['appends']} appends, "
+         f"{journal.stats['records']} records)")
+
+    ledger["token_identical"] = not any("diverged" in p
+                                        for p in problems)
+    ledger["zero_loss"] = not any("lost" in p for p in problems)
+    print("CHAOS " + json.dumps(ledger), flush=True)
+    if problems:
+        for p in problems:
+            _log(f"FAIL: {p}")
+        return 1
+    _log("chaos drill PASSED")
+    return 0
+
+
+# ------------------------------------------------------ subprocess mode
+def _drill_requests():
+    """The restart drill's fixed request set — both child legs derive
+    the SAME requests from the same seeds (mixed greedy and seeded
+    sampling; the seeded ones prove the key-schedule replay, not just
+    argmax determinism)."""
+    import numpy as np
+
+    from apex_tpu.serving.serve import Request
+
+    rng = np.random.RandomState(23)
+    reqs = []
+    for i in range(8):
+        plen = 8 + int(rng.randint(0, 12))
+        prompt = [int(t) for t in rng.randint(1, 64, (plen,))]
+        reqs.append(Request(
+            uid=f"d{i}", prompt=prompt, max_new_tokens=10,
+            seed=None if i % 2 == 0 else 1000 + i))
+    return reqs
+
+
+def _quantized_world(root: str, *, restore: bool):
+    """Build the drill's serving world on an int8-quantized weight
+    pool.  ``restore=False`` (first life / reference): seeded init,
+    checkpoint the raw params and the quantized pool.
+    ``restore=True`` (second life): restore raw params from the
+    checkpoint seam, re-derive the pool, and assert it is
+    BIT-IDENTICAL to the pool the first life served."""
+    import jax
+    import numpy as np
+
+    from apex_tpu import checkpoint as ckpt
+    from apex_tpu.models.gpt import quantize_gpt_weights
+
+    ck_params = os.path.join(root, "ckpt_params")
+    ck_qpool = os.path.join(root, "ckpt_qpool")
+    if restore:
+        params = ckpt.restore(ck_params)
+        qpool = quantize_gpt_weights(params, "int8", block_size=32)
+        saved = ckpt.restore(ck_qpool)
+        leaves_a = jax.tree_util.tree_leaves(qpool)
+        leaves_b = jax.tree_util.tree_leaves(saved)
+        assert len(leaves_a) == len(leaves_b)
+        for a, b in zip(leaves_a, leaves_b):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                raise AssertionError(
+                    "re-derived quantized pool is not bit-identical "
+                    "to the pool the first life served")
+        print("QPOOL_IDENTICAL", flush=True)
+    else:
+        model, params, _, _, _ = _mk_world()     # seeded init
+        ckpt.save(ck_params, params)
+        qpool = quantize_gpt_weights(params, "int8", block_size=32)
+        ckpt.save(ck_qpool, qpool)
+    return _mk_world(params_tree=qpool)
+
+
+def run_child(root: str, leg: str) -> int:
+    from apex_tpu.fleet import (
+        FleetRouter,
+        RequestJournal,
+        recover_journal,
+    )
+
+    model, params, ccfg, fns, maxp = _quantized_world(
+        root, restore=(leg == "resume"))
+    reqs = _drill_requests()
+
+    if leg == "ref":
+        router = FleetRouter(_mk_replicas(ccfg, fns, maxp))
+        for q in reqs:
+            assert router.submit(q)
+        router.drain()
+        with open(os.path.join(root, "streams_ref.json"), "w") as f:
+            json.dump(_streams(router), f)
+        print("DONE", flush=True)
+        return 0
+
+    if leg == "serve":
+        journal = RequestJournal(os.path.join(root, "journal.jsonl"))
+        router = FleetRouter(_mk_replicas(ccfg, fns, maxp),
+                             journal=journal)
+        for q in reqs:
+            assert router.submit(q)
+        step = 0
+        while router.pending:
+            router.step()
+            step += 1
+            print(f"WINDOW {step} pending {router.pending}",
+                  flush=True)
+        print("DONE", flush=True)       # parent should have killed us
+        return 0
+
+    if leg == "resume":
+        path = os.path.join(root, "journal.jsonl")
+        rec = recover_journal(path)
+        router = FleetRouter(_mk_replicas(ccfg, fns, maxp),
+                             journal=RequestJournal(path))
+        out = router.resume_from_journal(rec)
+        print("REPLAYED " + json.dumps(out), flush=True)
+        router.drain()
+        with open(os.path.join(root, "streams_resumed.json"),
+                  "w") as f:
+            json.dump(_streams(router), f)
+        print("DONE", flush=True)
+        return 0
+
+    raise SystemExit(f"unknown child leg {leg!r}")
+
+
+def _spawn(root: str, leg: str):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", leg,
+         "--root", root],
+        stdout=subprocess.PIPE, text=True, bufsize=1, env=env)
+
+
+def run_restart_drill(root: str, kill_after_windows: int) -> int:
+    if os.path.isdir(root):
+        shutil.rmtree(root)
+    os.makedirs(root)
+
+    # ---- leg 0: the never-killed reference (also writes the ckpts) --
+    _log("leg 0: reference serve (and checkpoint the weight pools)")
+    child = _spawn(root, "ref")
+    out, _ = child.communicate(timeout=600)
+    if child.returncode != 0 or "DONE" not in out:
+        _log(f"FAIL: reference child exited {child.returncode}")
+        sys.stdout.write(out or "")
+        return 1
+    ref = json.load(open(os.path.join(root, "streams_ref.json")))
+    _log(f"reference streams: {len(ref)} requests")
+
+    # ---- leg 1: serve with the journal, SIGKILL mid-serve -----------
+    _log(f"leg 1: serve, SIGKILL after {kill_after_windows} windows")
+    child = _spawn(root, "serve")
+    windows = 0
+    try:
+        for line in child.stdout:
+            line = line.strip()
+            if m := re.match(r"WINDOW (\d+) pending (\d+)", line):
+                windows = int(m.group(1))
+                if windows >= kill_after_windows \
+                        and int(m.group(2)) > 0:
+                    _log(f"SIGKILL at window {windows} "
+                         f"({m.group(2)} requests in flight)")
+                    child.send_signal(signal.SIGKILL)
+                    break
+            elif line == "DONE":
+                _log("FAIL: serve child drained before the kill "
+                     "window — raise the request count")
+                return 1
+    finally:
+        child.wait(timeout=60)
+        child.stdout.close()
+
+    # ---- leg 2: the next life recovers from disk --------------------
+    _log("leg 2: restore checkpoint, replay journal, resume")
+    child = _spawn(root, "resume")
+    out, _ = child.communicate(timeout=600)
+    if child.returncode != 0 or "DONE" not in out:
+        _log(f"FAIL: resume child exited {child.returncode}")
+        sys.stdout.write(out or "")
+        return 1
+    if "QPOOL_IDENTICAL" not in out:
+        _log("FAIL: resume child did not verify the quantized pool")
+        return 1
+    m = re.search(r"^REPLAYED (\{.*\})$", out, re.M)
+    replayed = json.loads(m.group(1)) if m else {}
+    resumed = json.load(open(os.path.join(root,
+                                          "streams_resumed.json")))
+
+    # ---- the ledger -------------------------------------------------
+    problems = []
+    if set(resumed) != set(ref):
+        problems.append(
+            f"zero-loss violated: reference has {sorted(ref)}, "
+            f"resumed life has {sorted(resumed)}")
+    for uid in sorted(set(resumed) & set(ref)):
+        if resumed[uid] != ref[uid]:
+            problems.append(f"token identity violated for {uid}")
+    if replayed.get("resumed", 0) < 1:
+        problems.append(
+            f"the kill landed with nothing in flight ({replayed}) — "
+            f"the drill proved nothing; lower --kill-after-windows")
+    print("CHAOS " + json.dumps({
+        "mode": "restart", "requests": len(ref),
+        "killed_at_window": windows, "replayed": replayed,
+        "token_identical": not any("identity" in p
+                                   for p in problems),
+        "zero_loss": not any("zero-loss" in p for p in problems),
+    }), flush=True)
+    if problems:
+        for p in problems:
+            _log(f"FAIL: {p}")
+        return 1
+    _log(f"restart drill: {replayed.get('completed', 0)} completed + "
+         f"{replayed.get('resumed', 0)} in-flight recovered, all "
+         f"token-identical — chaos drill PASSED")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--subprocess", action="store_true",
+                    help="run the SIGKILL restart drill")
+    ap.add_argument("--root", default="/tmp/apex_tpu_chaos_drill")
+    ap.add_argument("--kill-after-windows", type=int, default=7,
+                    help="serve windows before SIGKILL (late enough that\n                    some requests have COMPLETED — both recovery paths run)")
+    ap.add_argument("--child", choices=("ref", "serve", "resume"),
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.child:
+        return run_child(args.root, args.child)
+    if args.subprocess:
+        return run_restart_drill(args.root, args.kill_after_windows)
+    return run_matrix()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -193,6 +193,8 @@ def replay(
                 "itl_ms": (None if c.itl_ms is None
                            else round(c.itl_ms, 3)),
             }
+            if getattr(c, "hedged", False):
+                rec["hedged"] = True
         else:            # unreachable when drain finished
             rec = {"uid": uid, "slo": it.slo, "cohort": it.cohort,
                    "lost": True}
@@ -218,6 +220,11 @@ def summarize_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "lost": sum(1 for r in records if r.get("lost")),
         "migrated": sum(1 for r in records
                         if r.get("replays", 0) > 0),
+        # fault-tier ledger: requests cut off at their deadline (a
+        # per-request terminal, not a loss) and hedge-resolved streams
+        "deadline_missed": sum(1 for r in records
+                               if r.get("reason") == "deadline"),
+        "hedged": sum(1 for r in records if r.get("hedged")),
     }
     done = [r for r in records if "reason" in r]
     out["completed"] = len(done)

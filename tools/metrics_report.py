@@ -23,7 +23,13 @@ docs/observability.md) and reports what a final tokens/s number cannot:
   per-window decode tokens/s, time-to-first-token stats, inter-token
   latency percentiles, request completion counts by reason, chunked-
   prefill progress (``prefill_chunk`` spans), and the prefix-cache
-  scoreboard (hit rate, pages shared, prefill tokens skipped).
+  scoreboard (hit rate, pages shared, prefill tokens skipped);
+- **fault / recovery ledger** — when the stream came from a fleet run
+  with the fault-tolerance tier engaged: replica faults and
+  quarantines, migrations by cause, deadline misses (retried vs
+  terminal), hedge spawns/wins/losses, brownout transitions with the
+  pressure that drove them, journal replays, and per-class SLO
+  attainment (completions not cut off at their deadline).
 
 Usage::
 
@@ -341,6 +347,89 @@ def summarize_fleet(records: List[dict]) -> Optional[Dict[str, Any]]:
     return out
 
 
+def summarize_faults(records: List[dict]) -> Optional[Dict[str, Any]]:
+    """The fault/recovery section: what the fleet's fault-tolerance
+    tier did — replica faults/quarantines, migrations by cause,
+    deadline misses split into retried vs terminal, the hedge
+    scoreboard, brownout transitions, and journal replays — plus
+    per-class SLO attainment over the ``trace_request`` stream (the
+    fraction of completions NOT cut off at their deadline).  None when
+    the stream holds none of those events."""
+    ev = {}
+    for r in records:
+        if r.get("kind") == "event":
+            ev.setdefault(r.get("event"), []).append(r)
+    faults = ev.get("replica_fault", [])
+    quar = ev.get("replica_quarantined", [])
+    misses = ev.get("deadline_miss", [])
+    hedges = ev.get("hedge_spawn", [])
+    hwins = ev.get("hedge_win", [])
+    hlosses = ev.get("hedge_loss", [])
+    brown = ev.get("brownout", [])
+    replays = ev.get("journal_replayed", [])
+    migr = ev.get("request_migrated", [])
+    if not (faults or quar or misses or hedges or brown or replays):
+        return None
+    out: Dict[str, Any] = {}
+    if faults:
+        per: Dict[str, int] = {}
+        for r in faults:
+            name = str(r.get("replica", "?"))
+            per[name] = per.get(name, 0) + 1
+        out["replica_faults"] = {"count": len(faults), "by_replica": per}
+    if quar:
+        out["quarantined"] = [
+            {"replica": r.get("replica"), "cause": r.get("cause")}
+            for r in quar]
+    if migr:
+        by_cause: Dict[str, int] = {}
+        for r in migr:
+            c = str(r.get("cause", "replica_dead"))
+            by_cause[c] = by_cause.get(c, 0) + 1
+        out["migrations"] = {"count": len(migr), "by_cause": by_cause}
+    if misses:
+        retried = sum(1 for r in misses if r.get("retry"))
+        out["deadline_misses"] = {
+            "count": len(misses),
+            "retried": retried,
+            "terminal": len(misses) - retried,
+        }
+    if hedges or hwins or hlosses:
+        out["hedging"] = {"spawned": len(hedges), "wins": len(hwins),
+                          "losses": len(hlosses)}
+    if brown:
+        out["brownout"] = {
+            "transitions": len(brown),
+            "max_level": max(int(r.get("to_level", 0)) for r in brown),
+            "ladder": [
+                {"from": r.get("from_level"), "to": r.get("to_level"),
+                 "free_page_frac": r.get("free_page_frac"),
+                 "queue_depth": r.get("queue_depth")}
+                for r in brown],
+        }
+    if replays:
+        out["journal_replays"] = [
+            {k: r.get(k) for k in ("resumed", "completed", "corrupt",
+                                   "gapped")}
+            for r in replays]
+    # per-class SLO attainment over the trace stream: a completion
+    # whose reason is "deadline" burned its budget of time — everything
+    # else (eos/budget/...) made its SLO window
+    trace = [r for r in ev.get("trace_request", []) if "reason" in r]
+    if trace:
+        att: Dict[str, Any] = {}
+        for name in sorted({str(r.get("slo")) for r in trace}):
+            rs = [r for r in trace if str(r.get("slo")) == name]
+            missed = sum(1 for r in rs if r.get("reason") == "deadline")
+            att[name] = {
+                "n": len(rs),
+                "deadline_missed": missed,
+                "attainment": round(1.0 - missed / len(rs), 4),
+            }
+        out["slo_attainment"] = att
+    return out
+
+
 def summarize(records: List[dict]) -> Dict[str, Any]:
     """Aggregate one run's records into the report dict."""
     steps = [r for r in records if r.get("kind") == "step"]
@@ -442,7 +531,13 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
                       "shared_pages", "tokens_skipped", "copied",
                       # fleet router / failover / trace fields
                       "replica", "slo", "affinity", "replays",
-                      "migrated", "itl_ms", "rejected", "lost"):
+                      "migrated", "itl_ms", "rejected", "lost",
+                      # fault-tolerance tier fields: quarantine /
+                      # deadline / hedge / brownout / journal events
+                      "cause", "retry", "consecutive", "hedged",
+                      "primary", "from_level", "to_level",
+                      "free_page_frac", "queue_depth", "resumed",
+                      "corrupt", "gapped"):
                 if k in r:
                     entry[k] = r[k]
             timeline.append(entry)
@@ -455,6 +550,10 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
     fleet = summarize_fleet(records)
     if fleet:
         out["fleet"] = fleet
+
+    flt = summarize_faults(records)
+    if flt:
+        out["faults"] = flt
 
     return out
 
@@ -648,6 +747,48 @@ def format_report(summary: Dict[str, Any]) -> str:
                 row += (f"  itl p50 {c['itl_ms']['p50']}ms "
                         f"p99 {c['itl_ms']['p99']}ms")
             lines.append(row)
+    ft = summary.get("faults")
+    if ft:
+        lines.append("fault / recovery summary:")
+        rf = ft.get("replica_faults")
+        if rf:
+            by = "  ".join(f"{k}={v}"
+                           for k, v in sorted(rf["by_replica"].items()))
+            lines.append(f"  replica faults: {rf['count']} ({by})")
+        if "quarantined" in ft:
+            q = "  ".join(f"{r['replica']}({r['cause']})"
+                          for r in ft["quarantined"])
+            lines.append(f"  quarantined: {q}")
+        mg = ft.get("migrations")
+        if mg:
+            by = "  ".join(f"{k}={v}"
+                           for k, v in sorted(mg["by_cause"].items()))
+            lines.append(f"  migrations: {mg['count']} ({by})")
+        dm = ft.get("deadline_misses")
+        if dm:
+            lines.append(
+                f"  deadline misses: {dm['count']} "
+                f"({dm['retried']} retried, {dm['terminal']} terminal)")
+        hg = ft.get("hedging")
+        if hg:
+            lines.append(
+                f"  hedging: {hg['spawned']} spawned, "
+                f"{hg['wins']} wins, {hg['losses']} losses")
+        br = ft.get("brownout")
+        if br:
+            lines.append(
+                f"  brownout: {br['transitions']} transitions "
+                f"(peak level {br['max_level']})")
+        for jr in ft.get("journal_replays", []):
+            lines.append(
+                f"  journal replay: {jr.get('resumed', 0)} resumed, "
+                f"{jr.get('completed', 0)} already complete, "
+                f"{jr.get('corrupt', 0)} corrupt, "
+                f"{jr.get('gapped', 0)} gapped")
+        for name, a in sorted((ft.get("slo_attainment") or {}).items()):
+            lines.append(
+                f"  [{name}] slo attainment {a['attainment']:.1%} "
+                f"({a['deadline_missed']}/{a['n']} deadline-missed)")
     ev = summary.get("events")
     if ev:
         lines.append("events: " + "  ".join(

@@ -68,6 +68,13 @@ def heartbeat_note():
         return ""
     step = rec.get("step")
     where = f" at step {step}" if step is not None else ""
+    # a serving fleet's beat carries replica fields (Watchdog.beat
+    # extra=) — name the replica so a stale beat points at the pump
+    # that wedged, not just at "the process"
+    if rec.get("replica") is not None:
+        where += (f" (replica {rec['replica']}"
+                  f" serving step {rec.get('serving_step', '?')},"
+                  f" {rec.get('live_slots', '?')} live slots)")
     return f" | trainer heartbeat {age:.0f}s ago{where}"
 
 
