@@ -90,6 +90,17 @@ class GPTDecodeFns:
     spec: Any = None
     spec_jit: Any = None
     speculate_k: Any = None
+    #: static candidate-tree shape (a ``parents`` tuple, see
+    #: ``apex_tpu.serving.speculate``) the verify step was compiled
+    #: for; None = classic chain verification.  Mirrored as
+    #: ``spec.spec_tree`` so the batcher lays node tokens out for the
+    #: same shape the device expects.
+    spec_tree: Any = None
+    #: the draft source handed to ``decode_fns(draft_model=...)`` (a
+    #: ``ModelDraftSource`` — real serving state: its own weight pool
+    #: and KV slice).  Mirrored as ``spec.draft_source`` so the
+    #: batcher picks it up as the default drafter.
+    draft_source: Any = None
     #: the active weight width of the pool every step streams —
     #: "float32"/"bf16" for plain weights, "int8"/"int4" for quantized
     #: pools (``decode_fns(weight_dtype=...)``).  Mirrored as
@@ -1227,6 +1238,7 @@ class GPTModel:
         quantized: bool = False,
         kv_block: int = 128,
         weight_dtype: Optional[str] = None,
+        tree: Optional[tuple] = None,
     ):
         """ONE speculative verify step: :meth:`decode_step` widened to
         ``R = k + 1`` token rows per slot, ONE weight stream for all of
@@ -1245,6 +1257,22 @@ class GPTModel:
         new_pools)``: row j's logits predict the token AFTER j
         committed drafts, so the caller can accept a draft prefix and
         take its correction/bonus token from the same pass.
+
+        ``tree`` (a static ``parents`` tuple of length R,
+        ``apex_tpu.serving.speculate``) switches the R rows from one
+        chain to a candidate TREE verified in the same single weight
+        stream: row r embeds at its LOGICAL position ``lengths +
+        depth(r)`` (RoPE / learned-pos — siblings share a position)
+        while its K/V lands at the collision-free PHYSICAL slot
+        ``lengths + r``, and attention runs under the tree's static
+        ancestor matrix (``fmha_decode(ancestor=...)``) so each row
+        sees the committed cache plus exactly its root-to-node path.
+        Returns ``(logits, new_pools, (ks, vs))`` — the per-layer
+        post-RoPE K/V rows ``(L, S, h_local, R, d)`` stashed from the
+        scan, so the caller can rewrite the ACCEPTED path's rows to
+        their depth positions (the pass-2 commit) from the original
+        full-precision values (re-quantizing a dequantized page would
+        not be bit-stable).
 
         Rejection needs no cleanup here: the caller simply advances
         ``lengths`` by the accepted count, the kernel never attends
@@ -1269,9 +1297,29 @@ class GPTModel:
         max_len = page_table.shape[1] * page_size
         writev = valid & active[:, None] & (positions < max_len)
 
+        ancestor = None
+        logical = positions
+        if tree is not None:
+            from apex_tpu.serving.speculate import (
+                tree_ancestors, tree_depths,
+            )
+
+            tree = tuple(int(p) for p in tree)
+            if len(tree) != R:
+                raise ValueError(
+                    f"tree has {len(tree)} rows but tokens carry {R} — "
+                    "the parents tuple must cover every verify row")
+            ancestor = tree_ancestors(tree)
+            depths = jnp.asarray(tree_depths(tree), jnp.int32)
+            # siblings share a LOGICAL position (the token position the
+            # row claims) while their K/V lands at distinct PHYSICAL
+            # slots — depth drives rotation/embedding, row drives the
+            # write target
+            logical = lengths[:, None] + depths[None]
+
         x = self.embedding.apply(params["embedding"], tokens)
         if c.position_embedding == "learned":
-            pos = jnp.clip(positions, 0, c.max_position_embeddings - 1)
+            pos = jnp.clip(logical, 0, c.max_position_embeddings - 1)
             x = x + jnp.take(
                 params["pos_embedding"], pos, axis=0).astype(x.dtype)
         x = x.astype(c.compute_dtype)
@@ -1285,13 +1333,15 @@ class GPTModel:
             # verify rows rotate bit-identically to the one-token path
             cos_t, sin_t = rope_table(max_len, c.head_dim,
                                       base=c.rope_base)
-            pos = jnp.clip(positions, 0, max_len - 1)
+            pos = jnp.clip(logical, 0, max_len - 1)
             rope_cs = (jnp.take(cos_t, pos, axis=0),
                        jnp.take(sin_t, pos, axis=0))
 
         # the kernel's per-row causal mask sits at lengths - R + i
         # relative to attend = lengths + R, i.e. row i attends through
-        # position lengths + i — write-before-attend covers it
+        # position lengths + i — write-before-attend covers it (the
+        # ancestor mask replaces the in-window triangle with the
+        # tree's visibility, over the same window)
         attend = jnp.where(active, lengths + R, 0).astype(jnp.int32)
         wp, wo = write_targets(page_table, positions, writev, page_size)
         decode_impl = "xla" if c.attention_impl == "xla" else None
@@ -1318,20 +1368,27 @@ class GPTModel:
                 q, pool_l["k"], pool_l["v"], page_table, attend,
                 causal=True, k_scales=pool_l.get("k_scales"),
                 v_scales=pool_l.get("v_scales"), kv_block=kv_block,
-                rope=rope_cs, implementation=decode_impl)
+                rope=rope_cs, implementation=decode_impl,
+                ancestor=ancestor)
             attn = jnp.moveaxis(attn, 1, 2).reshape(S, R, -1)
             out = self._apply_linear(self.attn_proj, lp["attn_proj"],
                                      attn)
             x = residual + out.astype(residual.dtype)
             residual = x
             y = self._norm(lp["ln2"], x).astype(c.compute_dtype)
+            if tree is not None:
+                return (residual + self._dense_mlp(lp, y).astype(
+                    residual.dtype), (pool_l, k, v))
             y = self._dense_mlp(lp, y)
             return residual + y.astype(residual.dtype), pool_l
 
-        x, new_pools = jax.lax.scan(body, x, (params["layers"], pools))
+        x, scanned_out = jax.lax.scan(body, x, (params["layers"], pools))
         x = self._norm(params["final_ln"], x.astype(jnp.float32))
         logits = self.logits(params, x.astype(c.compute_dtype))
-        return logits, new_pools
+        if tree is not None:
+            new_pools, ks, vs = scanned_out
+            return logits, new_pools, (ks, vs)
+        return logits, scanned_out
 
     def decode_fns(
         self,
@@ -1346,6 +1403,7 @@ class GPTModel:
         eos_id: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
         speculate_k: Optional[int] = None,
+        spec_tree: Optional[tuple] = None,
         draft_model: Optional[Any] = None,
         weight_dtype: Optional[str] = None,
         weight_block: int = 128,
@@ -1362,10 +1420,28 @@ class GPTModel:
         ``s_q = k + 1`` followed by the fused Gumbel-coupled
         acceptance rule (:func:`apex_tpu.serving.sampling.spec_accept`)
         and an in-jit multi-token commit (lengths/steps_left/done all
-        advance by the accepted count).  ``draft_model`` is the seam
-        for a future small shared-tokenizer draft model and currently
-        raises — self-speculation (host n-gram drafting,
-        :mod:`apex_tpu.serving.speculate`) is the shipping source.
+        advance by the accepted count).  ``draft_model`` takes a
+        :class:`apex_tpu.serving.speculate.ModelDraftSource` (a small
+        shared-tokenizer draft GPT with its own paged KV slice and
+        quantized weight pool); it is validated against ``speculate_k``
+        / ``spec_tree`` and mirrored onto the returned struct as
+        ``draft_source`` so the batcher picks it up without extra
+        wiring — self-speculation (host n-gram drafting,
+        :mod:`apex_tpu.serving.speculate`) stays the default source.
+
+        ``spec_tree`` (a static ``parents`` tuple — see
+        :func:`apex_tpu.serving.speculate.offramp_tree`) upgrades the
+        chain verify to TREE verification: ``R = len(spec_tree)``
+        candidate rows attend under the tree's static ancestor matrix
+        in the same single weight stream, acceptance walks the tree
+        root-to-leaf with the SAME per-position key fold
+        (:func:`apex_tpu.serving.sampling.spec_accept_tree`), and the
+        accepted path's K/V rows are rewritten in-jit from their
+        collision-free physical slots to the committed depth positions
+        (pass-2), so the cache the next step attends over is exactly
+        what plain decode would have written.  Shapes stay fixed per
+        (width, tp, k, tree) — ONE compile covers every acceptance
+        pattern.
 
         All close over nothing dynamic: params ride as an argument
         through ONE jit each, every other shape comes from
@@ -1421,13 +1497,31 @@ class GPTModel:
         if self.moe is not None:
             self.moe.decode()    # raises: expert-parallel decode note
         if draft_model is not None:
-            raise NotImplementedError(
-                "draft-model speculation is a stub: the verify step, "
-                "acceptance rule and multi-token serving schedule are "
-                "draft-source-agnostic, but running a second model's "
-                "decode loop per step is not wired up — use "
-                "self-speculation (speculate_k=K with the host n-gram "
-                "draft source, apex_tpu.serving.speculate)")
+            if speculate_k is None:
+                raise ValueError(
+                    "draft_model given without speculate_k — the draft "
+                    "model drafts k tokens per verify window; pass "
+                    "speculate_k=K")
+            if not callable(getattr(draft_model, "draft", None)):
+                raise TypeError(
+                    "draft_model must be a DraftSource (a .draft "
+                    "method) — build one with "
+                    "apex_tpu.serving.speculate.ModelDraftSource")
+            dk = getattr(draft_model, "k", None)
+            if dk is not None and int(dk) != int(speculate_k):
+                raise ValueError(
+                    f"draft_model drafts k={dk} but speculate_k="
+                    f"{speculate_k} — the draft budget and the verify "
+                    "row count must agree")
+            dtree = getattr(draft_model, "tree", None)
+            if dtree is not None and spec_tree is not None and \
+                    tuple(int(p) for p in dtree) != \
+                    tuple(int(p) for p in spec_tree):
+                raise ValueError(
+                    "draft_model was built for a different candidate "
+                    f"tree ({tuple(dtree)}) than spec_tree="
+                    f"{tuple(spec_tree)} — the drafter's row layout "
+                    "and the verify step's ancestor mask must match")
         if parallel_state.get_pipeline_model_parallel_world_size() > 1:
             raise NotImplementedError(
                 "serving decode does not pipeline: initialize the mesh "
@@ -1667,6 +1761,113 @@ class GPTModel:
             }
             return pools, new_carry, targets, n_c
 
+        def _spec_tree(params, pools, carry, page_table, drafts,
+                       draft_len):
+            # tree verify-and-commit: R candidate rows (a static
+            # parents tree) through ONE weight stream under the
+            # ancestor mask, the coupled tree walk, then the pass-2
+            # rewrite that moves the ACCEPTED path's K/V rows from
+            # their collision-free physical slots (lengths + row) to
+            # the committed depth positions (lengths + depth) — all
+            # inside the jit, fixed shapes for every draft pattern
+            from apex_tpu.serving.kv_cache import (
+                write_targets, write_tokens,
+            )
+            from apex_tpu.serving.sampling import spec_accept_tree
+            from apex_tpu.serving.speculate import tree_depths
+
+            tree = _tree
+            R = len(tree)
+            jd = jnp.asarray(tree_depths(tree), jnp.int32)[None]
+            jrow = jnp.arange(R, dtype=jnp.int32)[None]       # (1, R)
+            active = jnp.logical_not(carry["done"])
+            lengths = carry["lengths"]
+            max_len = page_table.shape[1] * cfg.page_size
+            rows = jnp.concatenate(
+                [carry["tokens"][:, None], drafts.astype(jnp.int32)],
+                axis=1)                                        # (S, R)
+            phys = lengths[:, None] + jrow
+            # a node is live when its depth fits the drafted length AND
+            # its physical scratch slot fits the slot's page extent —
+            # the second guard keeps acceptance away from rows whose
+            # K/V was masked to the null page near the capacity edge
+            valid = (jd <= draft_len[:, None]) & (phys < max_len)
+            logits, pools, (ks, vs) = self.verify_step(
+                params, rows, lengths, active, valid, page_table,
+                pools, quantized=cfg.quantized, kv_block=cfg.kv_block,
+                weight_dtype=wd_active, tree=tree)
+            logits = _full_logits(logits)
+            # node r's children draw at absolute position lengths + 1 +
+            # depth(r): depth-keyed, NOT row-keyed, so every draw folds
+            # exactly what the plain one-token loop folds there and the
+            # committed stream stays key-schedule identical
+            ctx = jnp.where(active[:, None], lengths[:, None] + 1 + jd,
+                            0)
+            keys = jax.vmap(
+                jax.vmap(jax.random.fold_in, in_axes=(None, 0))
+            )(carry["sample_keys"], ctx)
+            outs, n_acc, path = jax.vmap(
+                lambda l, dr, v, kk: spec_accept_tree(
+                    l, dr, tree, v, kk, temperature, top_k, top_p)
+            )(logits, drafts, valid[:, 1:], keys)
+            # commit = accepted path + the correction/bonus draw, cut
+            # at the first committed EOS and capped at the slot's
+            # remaining budget — identical freeze rules to _spec
+            raw = n_acc + 1
+            is_eos = ((outs == eos_id) if eos_id is not None
+                      else jnp.zeros_like(outs, dtype=bool))
+            eos_run = is_eos & (jrow < raw[:, None])
+            any_eos = jnp.any(eos_run, axis=1)
+            first_eos = jnp.argmax(eos_run, axis=1).astype(jnp.int32)
+            n_c = jnp.where(any_eos, first_eos + 1, raw)
+            n_c = jnp.minimum(n_c, carry["steps_left"])
+            n_c = jnp.where(active, n_c, 0).astype(jnp.int32)
+            # pass-2: depth d's committed node (row path[d]) moves to
+            # position lengths + d.  Chain-shaped paths rewrite rows
+            # onto themselves (same post-RoPE values, same quantizer →
+            # same bytes); dead depths past n_acc land beyond the new
+            # length where the next step's writes cover them
+            dst = lengths[:, None] + jrow
+            rw = (active[:, None] & (jrow >= 1)
+                  & (jrow <= n_acc[:, None]) & (dst < max_len))
+            wp2, wo2 = write_targets(page_table, dst, rw,
+                                     cfg.page_size)
+
+            def rewrite(pool_l, kl, vl):
+                # (S, hl, R, d) --gather path rows--> (S*R, hl, d)
+                kl = jnp.take_along_axis(
+                    kl, path[:, None, :, None], axis=2)
+                vl = jnp.take_along_axis(
+                    vl, path[:, None, :, None], axis=2)
+                S = kl.shape[0]
+                return write_tokens(
+                    pool_l,
+                    jnp.moveaxis(kl, 1, 2).reshape(
+                        S * R, -1, kl.shape[-1]),
+                    jnp.moveaxis(vl, 1, 2).reshape(
+                        S * R, -1, vl.shape[-1]),
+                    wp2.reshape(-1), wo2.reshape(-1),
+                    quantized=cfg.quantized, kv_block=cfg.kv_block)
+
+            pools = jax.vmap(rewrite)(pools, ks, vs)
+            last = jnp.take_along_axis(
+                outs, jnp.clip(n_c - 1, 0, R - 1)[:, None],
+                axis=1)[:, 0]
+            tokens = jnp.where(active, last, carry["tokens"])
+            steps_left = carry["steps_left"] - n_c
+            eos_committed = jnp.any(
+                is_eos & (jrow < n_c[:, None]), axis=1)
+            done = carry["done"] | (
+                active & (eos_committed | (steps_left <= 0)))
+            new_carry = {
+                "tokens": tokens,
+                "lengths": carry["lengths"] + n_c,
+                "steps_left": steps_left,
+                "done": done,
+                "sample_keys": carry["sample_keys"],
+            }
+            return pools, new_carry, outs, n_c, path
+
         from apex_tpu.serving.serve import init_carry
 
         carry_tmpl = init_carry(cfg.max_seqs)
@@ -1736,6 +1937,11 @@ class GPTModel:
             chunk.prefill_chunk = C
 
         spec = sj = None
+        _tree = None
+        if spec_tree is not None and speculate_k is None:
+            raise ValueError(
+                "spec_tree given without speculate_k — the tree's max "
+                "depth IS the draft budget; pass speculate_k=K")
         if speculate_k is not None:
             from apex_tpu.ops.attention_decode import (
                 FMHA_DECODE_MAX_ROWS,
@@ -1753,26 +1959,66 @@ class GPTModel:
                     f"(FMHA_DECODE_MAX_ROWS={FMHA_DECODE_MAX_ROWS}); "
                     "acceptance saturates long before that anyway "
                     "(docs/serving.md, k-selection)")
-            sj = jax.jit(shard_map(
-                _spec, mesh=mesh,
-                in_specs=(specs, pool_specs, rep(carry_tmpl), P(), P(),
-                          P()),
-                out_specs=(pool_specs, rep(carry_tmpl), P(), P()),
-            ))
+            if spec_tree is not None:
+                from apex_tpu.serving.speculate import (
+                    tree_max_depth, validate_tree,
+                )
 
-            def spec(pools, carry, pt, drafts, draft_len, _sj=sj,
-                     _K=K):
-                drafts = jnp.asarray(drafts, jnp.int32).reshape(
-                    cfg.max_seqs, _K)
-                draft_len = jnp.asarray(draft_len, jnp.int32).reshape(
-                    cfg.max_seqs)
-                return _sj(params, pools, carry, pt, drafts, draft_len)
+                _tree = validate_tree(spec_tree)
+                if tree_max_depth(_tree) != K:
+                    raise ValueError(
+                        f"spec_tree has max depth "
+                        f"{tree_max_depth(_tree)} but speculate_k="
+                        f"{K} — the deepest root-to-leaf path is the "
+                        "draft budget; they must agree")
+                R = len(_tree)
+                if R > FMHA_DECODE_MAX_ROWS:
+                    raise ValueError(
+                        f"spec_tree has {R} rows, past the decode "
+                        f"kernel's per-program row budget "
+                        f"(FMHA_DECODE_MAX_ROWS="
+                        f"{FMHA_DECODE_MAX_ROWS}); prune the tree")
+                sj = jax.jit(shard_map(
+                    _spec_tree, mesh=mesh,
+                    in_specs=(specs, pool_specs, rep(carry_tmpl), P(),
+                              P(), P()),
+                    out_specs=(pool_specs, rep(carry_tmpl), P(), P(),
+                               P()),
+                ))
+
+                def spec(pools, carry, pt, drafts, draft_len, _sj=sj,
+                         _R=R):
+                    drafts = jnp.asarray(drafts, jnp.int32).reshape(
+                        cfg.max_seqs, _R - 1)
+                    draft_len = jnp.asarray(
+                        draft_len, jnp.int32).reshape(cfg.max_seqs)
+                    return _sj(params, pools, carry, pt, drafts,
+                               draft_len)
+            else:
+                sj = jax.jit(shard_map(
+                    _spec, mesh=mesh,
+                    in_specs=(specs, pool_specs, rep(carry_tmpl), P(),
+                              P(), P()),
+                    out_specs=(pool_specs, rep(carry_tmpl), P(), P()),
+                ))
+
+                def spec(pools, carry, pt, drafts, draft_len, _sj=sj,
+                         _K=K):
+                    drafts = jnp.asarray(drafts, jnp.int32).reshape(
+                        cfg.max_seqs, _K)
+                    draft_len = jnp.asarray(
+                        draft_len, jnp.int32).reshape(cfg.max_seqs)
+                    return _sj(params, pools, carry, pt, drafts,
+                               draft_len)
 
             # stamped like decode.eos_id / chunk.prefill_chunk: the
             # batcher drafts at ITS k and must reject a verify step
-            # compiled for another, or for a different freeze id
+            # compiled for another, or for a different freeze id /
+            # tree shape
             spec.eos_id = eos_id
             spec.speculate_k = K
+            spec.spec_tree = _tree
+            spec.draft_source = draft_model
 
         return GPTDecodeFns(
             prefill=prefill,
@@ -1788,6 +2034,8 @@ class GPTModel:
             spec_jit=sj,
             speculate_k=(None if speculate_k is None
                          else int(speculate_k)),
+            spec_tree=_tree,
+            draft_source=draft_model,
             weight_dtype=wd_active,
             weight_stream_bytes=wbytes,
             tp=tp_size,

@@ -113,6 +113,7 @@ class _DecodeConfig(NamedTuple):
     kv_block: int       # scale block width along d (int8 pages only)
     has_scales: bool
     has_rope: bool
+    ancestor: Optional[tuple] = None  # (sq, sq) static tree mask rows
 
 
 # ---------------------------------------------------------------------------
@@ -139,6 +140,7 @@ def paged_attention_reference(
     k_scales: Optional[jnp.ndarray] = None,
     v_scales: Optional[jnp.ndarray] = None,
     kv_block: int = _LANES,
+    ancestor: Optional[tuple] = None,
 ) -> jnp.ndarray:
     """Plain-XLA paged decode attention — the correctness reference.
 
@@ -149,6 +151,13 @@ def paged_attention_reference(
     ``lengths[b]`` positions.  The cache is expected to already contain
     the query tokens' own K/V (write-before-attend, so a decode token
     attends to itself).
+
+    ``ancestor`` replaces the in-window causal triangle with a static
+    (sq, sq) boolean matrix over the FRESH rows (cache positions
+    ``lengths[b] - sq + j``): query row ``i`` attends fresh row ``j``
+    iff ``ancestor[i][j]`` — tree speculation's per-branch visibility.
+    The committed prefix (positions ``< lengths[b] - sq``) stays fully
+    visible to every row.
     """
     b, h, sq, d = q.shape
     num_pages = page_table.shape[1]
@@ -170,7 +179,14 @@ def paged_attention_reference(
         preferred_element_type=jnp.float32,
     ) * scale
     k_pos = jnp.arange(num_pages * page_size)[None, None, None, :]
-    if causal:
+    if ancestor is not None:
+        amat = jnp.asarray(ancestor, dtype=bool)       # (sq, sq)
+        fresh = k_pos - (lengths[:, None, None, None] - sq)
+        in_window = (fresh >= 0) & (fresh < sq)
+        q_i = jnp.arange(sq)[None, None, :, None]
+        tree = amat[q_i, jnp.clip(fresh, 0, sq - 1)]
+        mask = (fresh < 0) | (in_window & tree)
+    elif causal:
         q_pos = (lengths[:, None, None, None] - sq
                  + jnp.arange(sq)[None, None, :, None])
         mask = k_pos <= q_pos
@@ -246,7 +262,28 @@ def _decode_kernel(*refs, cfg: _DecodeConfig):
             )                                                 # (sq, ps)
             k_pos = p * ps + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            if cfg.causal:
+            if cfg.ancestor is not None:
+                # tree verify: the last sq cache slots are the
+                # candidate rows; row i sees fresh slot j iff the
+                # STATIC ancestor matrix says so, plus the whole
+                # committed prefix.  Each row's allowed-column set is
+                # packed into an int32 bitmask selected by row iota
+                # (Pallas kernels cannot capture constant arrays), so
+                # the mask is sq scalar selects + one variable shift —
+                # VPU work that hides under the page DMA.
+                fresh = k_pos - (ln - sq)
+                row = jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                bits = jnp.zeros_like(row)
+                for i in range(sq):
+                    rb = sum(int(cfg.ancestor[i][j]) << j
+                             for j in range(sq))
+                    bits = jnp.where(row == i, rb, bits)
+                fr = jnp.clip(fresh, 0, sq - 1)
+                tree = (jnp.right_shift(bits, fr) & 1) == 1
+                mask = (fresh < 0) | (
+                    (fresh >= 0) & (fresh < sq) & tree)
+            elif cfg.causal:
                 q_pos = ln - sq + jax.lax.broadcasted_iota(
                     jnp.int32, s.shape, 0)
                 mask = k_pos <= q_pos
@@ -389,6 +426,7 @@ def fmha_decode(
     rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     block_h: Optional[int] = None,
     implementation: Optional[str] = None,
+    ancestor: Optional[tuple] = None,
 ) -> jnp.ndarray:
     """Decode attention: ``q (b, h, sq, d)`` against a paged KV cache.
 
@@ -412,6 +450,13 @@ def fmha_decode(
 
     ``implementation``: None = platform default (Pallas on TPU, XLA
     reference otherwise), ``"pallas"`` strict, ``"xla"`` reference.
+
+    ``ancestor`` (static (sq, sq) rows of 0/1, lower-triangular with a
+    unit diagonal) switches the in-window causal triangle to TREE
+    visibility: query row ``i`` attends candidate row ``j`` iff
+    ``ancestor[i][j]`` — several speculative branches verified against
+    one committed prefix in one cache pass.  Requires ``causal=True``
+    (the committed prefix stays fully visible either way).
     """
     if (k_scales is None) != (v_scales is None):
         raise ValueError("int8 pages need BOTH k_scales and v_scales")
@@ -446,6 +491,33 @@ def fmha_decode(
         )
     scale = (1.0 / d**0.5) if sm_scale is None else float(sm_scale)
 
+    if ancestor is not None:
+        if not causal:
+            raise ValueError(
+                "ancestor mask requires causal=True — tree rows refine "
+                "the causal window, they do not replace the length mask")
+        ancestor = tuple(
+            tuple(bool(x) for x in row) for row in ancestor)
+        if len(ancestor) != sq or any(len(r) != sq for r in ancestor):
+            raise ValueError(
+                f"ancestor must be ({sq}, {sq}) to match s_q, got "
+                f"({len(ancestor)}, "
+                f"{len(ancestor[0]) if ancestor else 0})")
+        if sq > 31:
+            raise ValueError(
+                f"ancestor s_q {sq} > 31 — the kernel packs each "
+                "row's visibility into an int32 bitmask; speculative "
+                "trees are a small handful of rows by design")
+        for i, row in enumerate(ancestor):
+            if not row[i]:
+                raise ValueError(
+                    f"ancestor diagonal must be 1 (row {i} attends "
+                    "itself — write-before-attend)")
+            if any(row[i + 1:]):
+                raise ValueError(
+                    f"ancestor row {i} attends a later row — the tree "
+                    "must be topologically ordered (lower-triangular)")
+
     from apex_tpu.ops.common import KernelLoweringError, run_kernel
     from apex_tpu.utils.platform import default_implementation
 
@@ -473,7 +545,7 @@ def fmha_decode(
         return paged_attention_reference(
             qq, k_pages, v_pages, page_table, lengths, causal=causal,
             sm_scale=scale, k_scales=k_scales, v_scales=v_scales,
-            kv_block=kv_block,
+            kv_block=kv_block, ancestor=ancestor,
         )
 
     def _pallas_path():
@@ -495,7 +567,7 @@ def fmha_decode(
             sm_scale=scale, causal=causal, sq=sq, block_h=bh,
             page_size=k_pages.shape[2], num_pages=page_table.shape[1],
             kv_block=int(kv_block), has_scales=k_scales is not None,
-            has_rope=rope is not None,
+            has_rope=rope is not None, ancestor=ancestor,
         )
         q_rot = cos = sin = None
         if rope is not None:

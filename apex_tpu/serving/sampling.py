@@ -24,7 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["greedy", "sample", "spec_accept"]
+__all__ = ["greedy", "sample", "spec_accept", "spec_accept_tree"]
 
 _NEG_INF = -1e30
 
@@ -163,3 +163,114 @@ def spec_accept(
     # longest accepted PREFIX: one mismatch rejects everything after it
     n_accept = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
     return targets, n_accept.astype(jnp.int32)
+
+
+def spec_accept_tree(
+    logits: jnp.ndarray,
+    drafts: jnp.ndarray,
+    parents: tuple,
+    valid: jnp.ndarray,
+    keys: Optional[jnp.ndarray],
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+):
+    """Coupled accept/commit over a candidate TREE for one slot.
+
+    ``logits (R, vocab)`` are the verify step's rows for R tree nodes
+    in topological order — node 0 is the last committed token (the
+    root), node ``r >= 1`` carries draft token ``drafts[r-1]`` and
+    hangs off ``parents[r] < r`` (``parents`` is STATIC: tree shape is
+    part of the jit signature, contents are not).  ``valid (R-1,)``
+    masks which draft nodes are real this step (depth within the
+    drafted length, physical cache room).  ``keys (R, ...)`` are the
+    per-node PRNG keys folded at each node's ABSOLUTE token position
+    ``ctx = lengths + 1 + depth(node)`` — depth-keyed, so every node at
+    one depth shares the exact key the plain one-token schedule would
+    use for that position.
+
+    Returns ``(out (R,) int32, n_accept () int32, path (R,) int32)``:
+    ``out[t]`` is the token committed at new-position ``t``,
+    ``n_accept`` the depth of the deepest accepted node, ``path[t]``
+    the row index of the committed node at depth ``t`` (the caller
+    commits ``out[:n_accept + 1]`` and rewrites accepted rows' K/V from
+    their physical slots to their depth positions).
+
+    **Why the tree stays distribution-preserving and token-identical.**
+    Each node ``p`` gets ONE target draw ``targets[p] = argmax(x_p +
+    G)`` with ``G`` keyed by the absolute position of ``p``'s children
+    — the same draw the plain sampler would make after committing the
+    path to ``p``.  A child ``r`` is accepted iff ``drafts[r-1] ==
+    targets[parents[r]]``: siblings are point-mass draft candidates
+    tested against that single shared draw, so at most one DISTINCT
+    sibling token can match (equal-token siblings resolve
+    first-in-row-order — they commit the same token either way), and
+    the committed root-to-leaf path is exactly the chain the plain
+    schedule would have produced, just discovered k-at-a-time.  On
+    rejection the bonus ``targets[last path node]`` IS the plain
+    sampler's token for that position.  A chain-shaped ``parents``
+    reduces this to :func:`spec_accept` bit-for-bit.
+    """
+    if logits.ndim != 2:
+        raise ValueError(f"logits must be (rows, vocab), got {logits.shape}")
+    rows = logits.shape[0]
+    parents = tuple(int(p) for p in parents)
+    if len(parents) != rows:
+        raise ValueError(
+            f"parents must have {rows} entries (one per logit row), got "
+            f"{len(parents)}")
+    if parents[0] != -1:
+        raise ValueError(f"parents[0] must be -1 (root), got {parents[0]}")
+    for r in range(1, rows):
+        if not 0 <= parents[r] < r:
+            raise ValueError(
+                f"parents[{r}] = {parents[r]} must be in [0, {r}) — "
+                "topological order")
+    if drafts.shape != (rows - 1,):
+        raise ValueError(
+            f"drafts must be ({rows - 1},) for {rows} logit rows, got "
+            f"{drafts.shape}")
+    if valid.shape != (rows - 1,):
+        raise ValueError(
+            f"valid must be ({rows - 1},), got {valid.shape}")
+    depth = [0] * rows
+    for r in range(1, rows):
+        depth[r] = depth[parents[r]] + 1
+    if temperature == 0.0:
+        targets = greedy(logits)
+    else:
+        if keys is None:
+            raise ValueError("temperature > 0 requires per-node PRNG keys")
+        targets = jax.vmap(
+            lambda l, kk: sample(l[None], kk, temperature, top_k, top_p)[0]
+        )(logits, keys)
+    ok = jnp.concatenate(
+        [jnp.ones((1,), bool), valid.astype(bool)])
+    cur = jnp.zeros((), jnp.int32)
+    n_acc = jnp.zeros((), jnp.int32)
+    out_rows, path_rows = [], []
+    # greedy root-to-leaf walk, statically unrolled per depth level (R
+    # is a small speculative handful): at the current path node, the
+    # first valid child whose draft equals that node's single target
+    # draw extends the path; no child matching ends it — the stalled
+    # node's draw is the bonus/correction token.  A stalled walk can
+    # never resume: level t+1 nodes hang off depth-t parents only.
+    for t in range(rows):
+        path_rows.append(cur)
+        out_rows.append(jnp.take(targets, cur))
+        level = [r for r in range(1, rows) if depth[r] == t + 1]
+        if not level:
+            continue
+        tgt_cur = jnp.take(targets, cur)
+        found = jnp.zeros((), bool)
+        nxt = cur
+        for r in level:
+            hit = ((~found) & ok[r]
+                   & (jnp.int32(parents[r]) == cur)
+                   & (drafts[r - 1].astype(jnp.int32) == tgt_cur))
+            nxt = jnp.where(hit, jnp.int32(r), nxt)
+            found = found | hit
+        n_acc = n_acc + found.astype(jnp.int32)
+        cur = jnp.where(found, nxt, cur)
+    return (jnp.stack(out_rows), n_acc.astype(jnp.int32),
+            jnp.stack(path_rows))

@@ -300,17 +300,46 @@ class ContinuousBatcher:
         self.spec_fn = spec_fn
         self.speculate_k = (None if speculate_k is None
                             else int(speculate_k))
+        #: static candidate-tree parents when spec_fn was compiled for
+        #: TREE verification (decode_fns(spec_tree=...)); None = chain
+        self.spec_tree = getattr(spec_fn, "spec_tree", None)
+        self._tree_chain_rows: tuple = ()
+        if self.spec_tree is not None:
+            from apex_tpu.serving.speculate import tree_chain_rows
+
+            self.spec_tree = tuple(int(p) for p in self.spec_tree)
+            self._tree_chain_rows = tree_chain_rows(self.spec_tree)
+        if spec_fn is not None and draft_source is None:
+            # a draft model bound at decode_fns(draft_model=...) rides
+            # the compiled step into the batcher; n-gram
+            # self-speculation stays the fallback
+            draft_source = getattr(spec_fn, "draft_source", None)
         if spec_fn is not None and draft_source is None:
             from apex_tpu.serving.speculate import NGramDraftSource
 
             draft_source = NGramDraftSource(self.speculate_k)
+        if draft_source is not None:
+            ds_tree = getattr(draft_source, "tree", None)
+            if ds_tree is not None and self.spec_tree is not None and \
+                    tuple(int(p) for p in ds_tree) != self.spec_tree:
+                raise ValueError(
+                    "draft_source drafts for a different candidate "
+                    f"tree ({tuple(ds_tree)}) than spec_fn verifies "
+                    f"({self.spec_tree}) — rebuild one of them")
+            if ds_tree is not None and self.spec_tree is None:
+                raise ValueError(
+                    "draft_source drafts a candidate tree but spec_fn "
+                    "verifies a chain — pass the same tree to "
+                    "decode_fns(spec_tree=...)")
         self.draft_source = draft_source
         #: host-side speculation scoreboard (the bench rows and the
         #: accepted-tokens/step gates read it): per-verify-step totals
-        #: plus per-draft-source hit counts
+        #: plus per-draft-source hit counts, off-ramp (non-first-child
+        #: tree path) commits, and host draft wall-time
         self.spec_stats = {
             "steps": 0, "slot_steps": 0, "drafted": 0, "accepted": 0,
-            "committed": 0, "by_source": {},
+            "committed": 0, "by_source": {}, "offramp": 0,
+            "draft_s": 0.0,
         }
         self.prefill_fn = prefill_fn
         self.decode_fn = decode_fn
@@ -690,9 +719,15 @@ class ContinuousBatcher:
         ever written past the slot's reserved pages."""
         k = self.speculate_k
         S = self.cache.config.max_seqs
+        tree = self.spec_tree
+        # chain mode offers k draft columns; tree mode offers one per
+        # non-root node (rows 1..R-1 of the static parents tuple)
+        n_cols = k if tree is None else len(tree) - 1
+        chain_rows = self._tree_chain_rows
         page_table = jnp.asarray(self.cache.page_table)
         t0 = time.perf_counter()
         chunk_s = 0.0
+        draft_s = 0.0
         steps = kept = 0
         done_h = None
         for _ in range(self.harvest_every):
@@ -717,7 +752,7 @@ class ContinuousBatcher:
                 if not did_chunk:
                     break
                 continue
-            drafts = np.zeros((S, k), np.int32)
+            drafts = np.zeros((S, n_cols), np.int32)
             dlens = np.zeros((S,), np.int32)
             sources: Dict[int, str] = {}
             for s, m in live:
@@ -729,25 +764,56 @@ class ContinuousBatcher:
                 cap = min(k, rem - 1)
                 if cap <= 0:
                     continue
+                td = time.perf_counter()
                 toks, src = self.draft_source.draft(
                     list(m["req"].prompt) + m["tokens"],
                     len(m["req"].prompt))
+                draft_s += time.perf_counter() - td
+                if tree is not None and len(toks) == n_cols:
+                    # tree-aware source: one token per non-root node,
+                    # already laid out in row order; the device's
+                    # depth-vs-draft_len mask trims anything past cap
+                    drafts[s, :] = toks
+                    dlens[s] = min(k, cap)
+                    sources[s] = src
+                    continue
                 toks = toks[:cap]
                 if toks:
-                    drafts[s, :len(toks)] = toks
+                    if tree is None:
+                        drafts[s, :len(toks)] = toks
+                    else:
+                        # chain-shaped source under a tree verify:
+                        # place the chain on the tree's first-child
+                        # spine, leave sibling rows padded (pad rows
+                        # only commit when they EQUAL the coupled
+                        # target draw, which is the identical token)
+                        for i, row in enumerate(
+                                chain_rows[:len(toks)]):
+                            drafts[s, row - 1] = toks[i]
                     dlens[s] = len(toks)
                     sources[s] = src
+            path_h = None
             with phase("decode"):
-                self.pools, self.carry, out, n_commit = self.spec_fn(
-                    self.pools, self.carry, page_table,
-                    drafts, dlens)
-            out_h, nc_h, done_h = _device_get(
-                (out, n_commit, self.carry["done"]))
+                if tree is None:
+                    self.pools, self.carry, out, n_commit = \
+                        self.spec_fn(self.pools, self.carry,
+                                     page_table, drafts, dlens)
+                else:
+                    (self.pools, self.carry, out, n_commit,
+                     path) = self.spec_fn(self.pools, self.carry,
+                                          page_table, drafts, dlens)
+            if tree is None:
+                out_h, nc_h, done_h = _device_get(
+                    (out, n_commit, self.carry["done"]))
+            else:
+                out_h, nc_h, path_h, done_h = _device_get(
+                    (out, n_commit, path, self.carry["done"]))
             self.steps += 1
             steps += 1
-            drafted = accepted = committed = 0
+            drafted = accepted = committed = offramp = 0
             commits: List[int] = []
             ev_src: Dict[str, Dict[str, int]] = {}
+            chain_set = set(chain_rows)
             for s, m in live:
                 nc = int(nc_h[s])
                 for j in range(nc):
@@ -762,6 +828,12 @@ class ContinuousBatcher:
                         m["finished"] = "budget"
                 dl = int(dlens[s])
                 acc = max(min(nc - 1, dl), 0)
+                if path_h is not None:
+                    # committed tree nodes off the first-child spine =
+                    # tokens a chain verify would have rejected
+                    offramp += sum(
+                        1 for t in range(1, acc + 1)
+                        if int(path_h[s, t]) not in chain_set)
                 drafted += dl
                 accepted += acc
                 committed += nc
@@ -778,6 +850,7 @@ class ContinuousBatcher:
             st["drafted"] += drafted
             st["accepted"] += accepted
             st["committed"] += committed
+            st["offramp"] += offramp
             for src, rec in ev_src.items():
                 tot = st["by_source"].setdefault(
                     src, {"drafted": 0, "accepted": 0})
@@ -789,15 +862,17 @@ class ContinuousBatcher:
             self._event("spec_accept", slots=len(live),
                         drafted=drafted, accepted=accepted,
                         committed=committed, commits=commits,
-                        by_source=ev_src)
+                        by_source=ev_src, offramp=offramp)
         t_h = time.perf_counter()
         self.windows += 1
+        self.spec_stats["draft_s"] += draft_s
         if done_h is None:
             done_h = _device_get(self.carry["done"])
         self._event(
             "span", span="decode", steps=steps,
             slots=len(self._meta), tokens=kept,
             dur_s=round(max(t_h - t0 - chunk_s, 0.0), 6),
+            draft_s=round(draft_s, 6),
             **self._weight_fields(),
         )
         self._retire(done_h, t_h)

@@ -30,10 +30,21 @@ drafting with zero extra weights:
 - :class:`NullDraftSource` never drafts — the speculative step then
   commits exactly one token per weight stream, which is the reference
   the rollback bit-identity tests compare against.
-- :class:`ModelDraftSource` is the ``draft_model=`` seam: a future
-  small shared-tokenizer draft model slots in here (draft with the
-  small model, verify with the big one).  It raises loudly until that
-  model exists.
+- :class:`ModelDraftSource` is the MODEL tier: a small shared-tokenizer
+  GPT served from its own (int4 by default) weight pool and its own
+  small paged KV slice, running k greedy steps per window through the
+  same chunked-prefill machinery the target uses.  It drafts on
+  adversarial/creative prompts where n-gram lookup finds nothing — at
+  the cost of the draft's weight stream and KV residency
+  (docs/serving.md weighs the ladder).
+
+Tree speculation widens the draft from a chain to a small candidate
+TREE (``offramp_tree``: the greedy chain plus a top-2 alternate
+hanging off every chain node), verified in ONE weight stream through
+``GPTModel.verify_step``'s ancestor-masked rows — the helpers at the
+bottom of this module (``chain_tree`` / ``offramp_tree`` /
+``tree_depths`` / ``tree_ancestors``) define the static tree shapes
+the compiled verify step closes over.
 
 Because drafting is host-side, the speculative serving loop resolves
 each verify step's committed tokens before drafting the next — one
@@ -53,7 +64,116 @@ __all__ = [
     "NGramDraftSource",
     "NullDraftSource",
     "ModelDraftSource",
+    "chain_tree",
+    "offramp_tree",
+    "validate_tree",
+    "tree_depths",
+    "tree_max_depth",
+    "tree_ancestors",
+    "tree_chain_rows",
 ]
+
+
+# ---------------------------------------------------------------------------
+# Static candidate-tree shapes
+# ---------------------------------------------------------------------------
+#
+# A speculative tree is a ``parents`` tuple over R = 1 + n_draft rows:
+# row 0 is the slot's last committed token (the root), row r >= 1 is a
+# draft candidate hanging off ``parents[r] < r`` (topological order).
+# The tuple is STATIC — it is part of the verify step's jit signature
+# (the ancestor mask compiles into the kernel), while the node TOKENS
+# are runtime contents, so every acceptance pattern and every draft
+# reuses one compilation per tree shape.
+
+
+def validate_tree(parents) -> tuple:
+    """Canonicalize + validate a ``parents`` tuple: root first
+    (``parents[0] == -1``), every other node hangs off an EARLIER row.
+    Returns the canonical tuple of ints."""
+    parents = tuple(int(p) for p in parents)
+    if not parents:
+        raise ValueError("tree must have at least the root row")
+    if parents[0] != -1:
+        raise ValueError(
+            f"parents[0] must be -1 (the root row), got {parents[0]}")
+    for r in range(1, len(parents)):
+        if not 0 <= parents[r] < r:
+            raise ValueError(
+                f"parents[{r}] = {parents[r]} must be in [0, {r}) — "
+                "rows are topologically ordered")
+    return parents
+
+
+def chain_tree(k: int) -> tuple:
+    """The degenerate tree: one chain of ``k`` draft nodes.  A verify
+    step compiled for this shape is row-for-row the classic chain
+    verify (the ancestor mask IS the causal triangle)."""
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    return tuple([-1] + list(range(k)))
+
+
+def offramp_tree(k: int) -> tuple:
+    """Chain + off-ramps: rows ``1..k`` are the draft's greedy chain,
+    rows ``k+1..2k`` hang a SECOND candidate (the draft's runner-up
+    token) off every chain node — the whole tree falls out of the same
+    k draft steps that produce the chain (each step's logits give
+    top-1 AND top-2), and the main chain sits at its final positions
+    already so only accepted off-ramps need a KV rewrite.  One
+    rejection on the chain can still commit via the off-ramp at that
+    depth, which is where tree verification beats chain verification
+    on near-miss drafts."""
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    return tuple([-1] + list(range(k)) + list(range(k)))
+
+
+def tree_depths(parents) -> tuple:
+    """Depth per row (root = 0)."""
+    parents = validate_tree(parents)
+    depth = [0] * len(parents)
+    for r in range(1, len(parents)):
+        depth[r] = depth[parents[r]] + 1
+    return tuple(depth)
+
+
+def tree_max_depth(parents) -> int:
+    """Deepest draft node — the chain-``k`` equivalent of the tree
+    (at most this many drafts commit per verify step)."""
+    return max(tree_depths(parents))
+
+
+def tree_ancestors(parents) -> tuple:
+    """The (R, R) 0/1 ancestor matrix: ``A[r][j] == 1`` iff row j is
+    row r or an ancestor of row r — exactly the rows row r may attend
+    among the fresh candidate rows (``fmha_decode(ancestor=...)``).
+    Lower-triangular with a unit diagonal by construction."""
+    parents = validate_tree(parents)
+    R = len(parents)
+    A = [[0] * R for _ in range(R)]
+    for r in range(R):
+        p = r
+        while p >= 0:
+            A[r][p] = 1
+            p = parents[p]
+    return tuple(tuple(row) for row in A)
+
+
+def tree_chain_rows(parents) -> tuple:
+    """Row indices of the tree's FIRST-CHILD chain, depth 1 first —
+    where a chain-only draft source's tokens land when the verify step
+    is compiled for a tree shape (``offramp_tree``'s chain rows are
+    ``1..k``)."""
+    parents = validate_tree(parents)
+    rows, cur = [], 0
+    while True:
+        child = next((r for r in range(cur + 1, len(parents))
+                      if parents[r] == cur), None)
+        if child is None:
+            return tuple(rows)
+        rows.append(child)
+        cur = child
 
 
 class DraftSource:
@@ -147,20 +267,191 @@ class NullDraftSource(DraftSource):
 
 
 class ModelDraftSource(DraftSource):
-    """The ``draft_model=`` seam: draft with a SMALL shared-tokenizer
-    model, verify with the big one.  The serving plumbing (fixed-k
-    slot schedule, verify step, acceptance rule, multi-token harvest)
-    is draft-source-agnostic, so when a distilled draft checkpoint
-    exists it plugs in here — until then this raises at construction
-    so nobody silently serves with an unimplemented draft."""
+    """Model-based drafting: a SMALL shared-tokenizer GPT drafts k
+    greedy tokens per window; the big model verifies.
+
+    The draft model is real serving state, not a callback: it owns its
+    own small paged KV slice (a :class:`~apex_tpu.serving.kv_cache
+    .PagedKVCache` at a reduced config — same allocator, same null-page
+    discipline as the target's pool) and its own weight pool, int4 by
+    default through the :func:`~apex_tpu.models.gpt
+    .quantize_gpt_weights` seam, so the per-window draft cost is a ~8×
+    smaller weight stream than full width and the draft is co-resident
+    with the target in the serving memory audit
+    (``tools/memory_audit.py --serve --draft-tier``).
+
+    Mechanically the draft runs through the SAME chunked-prefill
+    machinery as the target (``GPTModel.decode_fns(prefill_chunk=...)``
+    — fixed chunk shapes, zero recompiles across contexts): ingest the
+    committed context delta in C-token chunks, then step greedily one
+    token at a time, reading each step's logits back for top-1 (the
+    chain) and top-2 (the ``offramp_tree`` alternates when ``tree`` is
+    given).  Drafting stays a pure function of the context — the
+    internal per-slot KV memoization is a COST optimization only
+    (chunk boundaries produce bit-identical pools and logits for any
+    ingestion schedule, the ``prefill_chunk`` numerics contract), so
+    fleet failover replay re-drafts identically on a cold replica.
+
+    ``tree=None`` drafts a chain of ``k``; ``tree=offramp_tree(k)``
+    additionally returns the runner-up token at every chain node
+    (rows ``k+1..2k``), all from the same k draft steps.  The verify
+    step must be compiled for the same shape
+    (``decode_fns(speculate_k=k, spec_tree=...)``).
+    """
 
     name = "draft_model"
 
-    def __init__(self, draft_model, k: int):
-        raise NotImplementedError(
-            "draft-model speculation is a stub: self-speculation "
-            "(NGramDraftSource) is the shipping draft source.  A "
-            "shared-tokenizer draft model needs its own decode carry "
-            "and a per-slot draft loop before the verify step — the "
-            "acceptance rule and serving schedule here already "
-            "support it (docs/serving.md, 'Speculative decoding')")
+    def __init__(self, model, params, mesh, cache_config, *, k: int,
+                 tree=None, weight_dtype: Optional[str] = "int4",
+                 weight_block: int = 128, ingest_chunk: int = 16):
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.serving.kv_cache import PagedKVCache, init_pools
+
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.k = int(k)
+        self.tree = None
+        if tree is not None:
+            tree = validate_tree(tree)
+            if tree not in (chain_tree(self.k), offramp_tree(self.k)):
+                raise ValueError(
+                    "ModelDraftSource drafts chain_tree(k) or "
+                    "offramp_tree(k) shapes (k greedy steps give "
+                    "top-1 + top-2 per depth); arbitrary trees need a "
+                    f"wider per-step beam — got {tree}")
+            self.tree = tree
+        if weight_dtype in ("int8", "int4"):
+            from apex_tpu.models.gpt import quantize_gpt_weights
+
+            params = quantize_gpt_weights(
+                params, weight_dtype, weight_block)
+        elif weight_dtype not in (None, "bf16"):
+            raise ValueError(
+                f"weight_dtype must be None, 'bf16', 'int8' or "
+                f"'int4', got {weight_dtype!r}")
+        C = int(ingest_chunk)
+        if C < 1:
+            raise ValueError(f"ingest_chunk must be >= 1, got {C}")
+        # two compiled chunk steps over ONE (possibly quantized) pool:
+        # a C-token chunk for context-delta ingestion and a 1-token
+        # chunk for the greedy draft steps (its returned logits carry
+        # the top-2 the tree needs — the plain decode step returns
+        # only the sampled id).  weight_dtype=None here: the pool was
+        # converted once above and decode_fns serves it as given.
+        wd = "bf16" if weight_dtype == "bf16" else None
+        self._fns_ingest = model.decode_fns(
+            params, mesh, cache_config,
+            max_prompt_len=cache_config.max_len, temperature=0.0,
+            prefill_chunk=C, weight_dtype=wd)
+        self._fns_step = model.decode_fns(
+            params, mesh, cache_config,
+            max_prompt_len=cache_config.max_len, temperature=0.0,
+            prefill_chunk=1, weight_dtype=wd)
+        self._C = C
+        self._cache = PagedKVCache(cache_config)
+        self._pools = init_pools(cache_config)
+        self._cfg = cache_config
+        self._key = jax.random.PRNGKey(0)    # greedy steps ignore it
+        self._ctx: dict = {}                 # slot -> ingested tokens
+        self._stamp: dict = {}               # slot -> LRU tick
+        self._tick = 0
+        self._jnp = jnp
+        #: telemetry stamps for the serving scoreboard / memory audit:
+        #: the draft's active weight width and per-step stream bytes
+        self.weight_dtype = self._fns_step.weight_dtype
+        self.weight_stream_bytes = self._fns_step.weight_stream_bytes
+        #: host wall seconds spent inside draft() — the batcher adds
+        #: this to its spec telemetry so tools/metrics_report.py can
+        #: report the draft-model cost as a fraction of the serving
+        #: wall
+        self.draft_s = 0.0
+
+    # ------------------------------------------------------- internals
+    def _slot_for(self, ctx: List[int]):
+        """Internal KV slot with the longest stored-context/``ctx``
+        common prefix (LRU on ties / no match).  Returns
+        ``(slot, matched_tokens)``."""
+        best_s, best_m = None, 0
+        for s, stored in self._ctx.items():
+            m = 0
+            for a, b in zip(stored, ctx):
+                if a != b:
+                    break
+                m += 1
+            if m > best_m:
+                best_s, best_m = s, m
+        if best_s is not None:
+            return best_s, best_m
+        free = [s for s in range(self._cfg.max_seqs)
+                if s not in self._ctx]
+        if free:
+            return free[0], 0
+        return min(self._stamp, key=self._stamp.get), 0
+
+    def _ensure_admitted(self, slot: int) -> None:
+        if slot not in self._cache._slot_pages:
+            self._cache.admit(slot, self._cfg.max_len)
+
+    def _row(self, slot: int):
+        return self._jnp.asarray(self._cache.page_table[slot])
+
+    def _top2(self, logits):
+        l = np.asarray(logits, np.float32)
+        t1 = int(np.argmax(l))
+        l2 = l.copy()
+        l2[t1] = -np.inf
+        return t1, int(np.argmax(l2))
+
+    # ----------------------------------------------------------- draft
+    def draft(self, context: Sequence[int], prompt_len: int
+              ) -> Tuple[List[int], Optional[str]]:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        ctx = [int(t) for t in context]
+        L = len(ctx)
+        # the draft needs room to FEED its chain: positions up to
+        # L + k - 2 get written, position L + k - 1 attended
+        if L < 1 or L + self.k > self._cfg.max_len:
+            self.draft_s += _time.perf_counter() - t0
+            return [], None
+        slot, m = self._slot_for(ctx)
+        self._ensure_admitted(slot)
+        self._tick += 1
+        self._stamp[slot] = self._tick
+        # always reprocess at least the last context token: its logits
+        # seed the chain (an exact-match memo hit has no pending chunk
+        # to read them from)
+        m = min(m, L - 1)
+        row = self._row(slot)
+        chunk = self._fns_ingest.chunk
+        pools, logits = self._pools, None
+        pos = m
+        while pos < L:
+            n = min(self._C, L - pos)
+            toks = ctx[pos:pos + n] + [0] * (self._C - n)
+            pools, _, logits = chunk(
+                pools, toks, pos, pos + n, pos, row, self._key)
+            pos += n
+        step = self._fns_step.chunk
+        chain: List[int] = []
+        alts: List[int] = []
+        for t in range(self.k):
+            t1, t2 = self._top2(logits)
+            chain.append(t1)
+            alts.append(t2)
+            if t < self.k - 1:
+                pools, _, logits = step(
+                    pools, [t1], L + t, L + t + 1, L + t, row,
+                    self._key)
+        self._pools = pools
+        # stored context = tokens whose K/V this slot now holds (the
+        # fed chain prefix rides along, so an accepted run's next
+        # window is a 1-2 token delta)
+        self._ctx[slot] = ctx + chain[:-1]
+        self.draft_s += _time.perf_counter() - t0
+        if self.tree is not None and len(self.tree) == 2 * self.k + 1:
+            return chain + alts, self.name
+        return chain, self.name

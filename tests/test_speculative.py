@@ -57,6 +57,13 @@ from apex_tpu.serving.speculate import (
     ModelDraftSource,
     NGramDraftSource,
     NullDraftSource,
+    chain_tree,
+    offramp_tree,
+    tree_ancestors,
+    tree_chain_rows,
+    tree_depths,
+    tree_max_depth,
+    validate_tree,
 )
 
 
@@ -108,9 +115,13 @@ class TestNGramDraftSource:
     def test_null_source_never_drafts(self):
         assert NullDraftSource().draft([1, 2, 3], 3) == ([], None)
 
-    def test_model_draft_seam_is_explicit_stub(self):
-        with pytest.raises(NotImplementedError):
-            ModelDraftSource(object(), 4)
+    def test_model_draft_source_validation(self):
+        # validation fires before any model machinery is touched
+        with pytest.raises(ValueError, match="k must be"):
+            ModelDraftSource(object(), {}, None, None, k=0)
+        with pytest.raises(ValueError, match="arbitrary trees"):
+            ModelDraftSource(object(), {}, None, None, k=2,
+                             tree=(-1, 0, 0, 1))
 
 
 # ---------------------------------------------------------------------------
@@ -223,7 +234,8 @@ PAGE, NEW, K = 4, 12, 3
 
 
 def _batcher(setup, *, spec=True, temperature=0.0, draft=None,
-             eos_id=None, max_seqs=2, logger=None):
+             eos_id=None, max_seqs=2, logger=None, tree=None,
+             draft_model=None):
     mesh, model, params, prompts, maxp = setup
     pps = -(-(maxp + NEW) // PAGE)
     ccfg = KVCacheConfig(
@@ -233,11 +245,15 @@ def _batcher(setup, *, spec=True, temperature=0.0, draft=None,
     fns = model.decode_fns(
         params, mesh, ccfg, max_prompt_len=maxp,
         temperature=temperature, eos_id=eos_id,
-        speculate_k=K if spec else None)
+        speculate_k=K if spec else None,
+        spec_tree=tree, draft_model=draft_model)
     kw = {}
     if spec:
-        kw = dict(spec_fn=fns.spec, speculate_k=K,
-                  draft_source=draft or NGramDraftSource(K))
+        # a bound draft_model rides in on fns.spec; otherwise the
+        # explicit source (or the n-gram default) drafts
+        src = (None if draft_model is not None
+               else draft or NGramDraftSource(K))
+        kw = dict(spec_fn=fns.spec, speculate_k=K, draft_source=src)
     return ContinuousBatcher(
         fns.prefill, fns.decode, PagedKVCache(ccfg), init_pools(ccfg),
         max_prompt_len=maxp, harvest_every=3, eos_id=eos_id,
@@ -474,6 +490,9 @@ class TestSpeculativeServing:
                    for src in ("ngram", "prompt_lookup"))
         for src, rec in sp["by_source"].items():
             assert 0.0 <= rec["hit_rate"] <= 1.0
+        assert sp["offramp_commits"] == b.spec_stats["offramp"]
+        assert sp["draft_wall_s"] >= 0.0
+        assert 0.0 <= sp["draft_wall_fraction"] < 1.0
         text = mr.format_report(summary)
         assert "speculation:" in text
         assert "tokens/slot-step" in text
@@ -502,9 +521,266 @@ class TestSpeculativeServing:
             make(spec_fn=fns.spec, speculate_k=K + 1)
         with pytest.raises(ValueError, match="draft_source"):
             make(draft_source=NGramDraftSource(K))
-        with pytest.raises(NotImplementedError):
+        with pytest.raises(TypeError, match="DraftSource"):
             model.decode_fns(params, mesh, ccfg, max_prompt_len=maxp,
                              speculate_k=K, draft_model=object())
+        with pytest.raises(ValueError, match="speculate_k"):
+            model.decode_fns(params, mesh, ccfg, max_prompt_len=maxp,
+                             spec_tree=chain_tree(K))
+        with pytest.raises(ValueError, match="max depth"):
+            model.decode_fns(params, mesh, ccfg, max_prompt_len=maxp,
+                             speculate_k=K + 1,
+                             spec_tree=chain_tree(K))
+
+
+# ---------------------------------------------------------------------------
+# candidate trees: helpers, the coupled tree walk, tree serving
+# ---------------------------------------------------------------------------
+
+
+class TestTreeHelpers:
+    def test_shapes_and_depths(self):
+        assert chain_tree(3) == (-1, 0, 1, 2)
+        assert offramp_tree(3) == (-1, 0, 1, 2, 0, 1, 2)
+        assert tree_depths(offramp_tree(3)) == (0, 1, 2, 3, 1, 2, 3)
+        assert tree_max_depth(offramp_tree(3)) == 3
+        assert tree_chain_rows(offramp_tree(3)) == (1, 2, 3)
+        assert tree_chain_rows(chain_tree(2)) == (1, 2)
+
+    def test_ancestor_matrix(self):
+        A = np.asarray(tree_ancestors(offramp_tree(2)))  # (-1,0,1,0,1)
+        assert (np.diag(A) == 1).all()          # write-before-attend
+        assert np.triu(A, 1).sum() == 0         # topological order
+        assert (A[:, 0] == 1).all()             # root in every path
+        # off-ramp row 3 hangs off the ROOT: it must not see the
+        # chain rows it is an alternative to
+        assert A[3, 1] == 0 and A[3, 2] == 0
+        # off-ramp row 4 hangs off chain row 1: sees it, not row 2
+        assert A[4, 1] == 1 and A[4, 2] == 0
+
+    def test_validate_tree_rejections(self):
+        with pytest.raises(ValueError):
+            validate_tree(())
+        with pytest.raises(ValueError):
+            validate_tree((0,))                # root's parent is -1
+        with pytest.raises(ValueError):
+            validate_tree((-1, 1))             # parent precedes child
+        with pytest.raises(ValueError):
+            validate_tree((-1, -1))            # ONE root
+
+
+class TestSpecAcceptTree:
+    V = 16
+
+    def _logits(self, rows, seed=0):
+        return jax.random.normal(jax.random.PRNGKey(seed),
+                                 (rows, self.V), jnp.float32)
+
+    def _keys(self, rows):
+        return jnp.stack([jax.random.PRNGKey(100 + i)
+                          for i in range(rows)])
+
+    @pytest.mark.parametrize("temperature", [0.0, 0.8])
+    def test_chain_tree_reduces_to_spec_accept(self, temperature):
+        """A chain-shaped parents tuple must reproduce spec_accept
+        bit-for-bit — the tree walk is a strict generalization."""
+        from apex_tpu.serving.sampling import spec_accept_tree
+
+        k = 3
+        logits = self._logits(k + 1, seed=1)
+        keys = self._keys(k + 1)
+        t_ref = (np.asarray(jnp.argmax(logits, axis=-1))
+                 if temperature == 0.0 else
+                 np.asarray(jax.vmap(
+                     lambda l, kk: sample(l[None], kk, temperature)[0]
+                 )(logits, keys)))
+        drafts = jnp.asarray(
+            [t_ref[0], t_ref[1], (t_ref[2] + 1) % self.V], jnp.int32)
+        out, n, path = spec_accept_tree(
+            logits, drafts, chain_tree(k), jnp.ones((k,), bool), keys,
+            temperature)
+        t_chain, n_chain = spec_accept(
+            logits, drafts, jnp.int32(k), keys, temperature)
+        assert int(n) == int(n_chain) == 2
+        nc = int(n) + 1
+        assert (np.asarray(out)[:nc].tolist()
+                == np.asarray(t_chain)[:nc].tolist())
+        assert np.asarray(path).tolist() == [0, 1, 2, 2]  # stalls
+
+    def test_offramp_rescues_rejected_chain(self):
+        from apex_tpu.serving.sampling import spec_accept_tree
+
+        tree = offramp_tree(2)                 # (-1, 0, 1, 0, 1)
+        logits = self._logits(5, seed=3)
+        g = np.asarray(jnp.argmax(logits, axis=-1))
+        # chain row 1 misses the target; off-ramp row 3 carries it
+        drafts = jnp.asarray(
+            [(g[0] + 1) % self.V, 0, g[0], (g[1] + 1) % self.V],
+            jnp.int32)
+        out, n, path = spec_accept_tree(
+            logits, drafts, tree, jnp.ones((4,), bool), None)
+        assert int(n) == 1
+        p = np.asarray(path).tolist()
+        assert p[0] == 0 and p[1] == 3
+        o = np.asarray(out)
+        # committed token = the coupled draw; correction comes from
+        # the ACCEPTED node's logits row
+        assert o[0] == g[0] and o[1] == g[3]
+
+    def test_equal_token_siblings_resolve_first_in_row_order(self):
+        from apex_tpu.serving.sampling import spec_accept_tree
+
+        tree = offramp_tree(2)
+        logits = self._logits(5, seed=4)
+        g = np.asarray(jnp.argmax(logits, axis=-1))
+        drafts = jnp.asarray([g[0], 0, g[0], 0], jnp.int32)
+        out, n, path = spec_accept_tree(
+            logits, drafts, tree, jnp.ones((4,), bool), None)
+        # both depth-1 candidates carry the target token: the CHAIN
+        # row wins (committed token is identical either way)
+        assert np.asarray(path).tolist()[1] == 1
+
+    def test_invalid_nodes_never_accepted(self):
+        from apex_tpu.serving.sampling import spec_accept_tree
+
+        tree = offramp_tree(2)
+        logits = self._logits(5, seed=5)
+        g = np.asarray(jnp.argmax(logits, axis=-1))
+        drafts = jnp.asarray([g[0], g[1], g[0], g[1]], jnp.int32)
+        out, n, _ = spec_accept_tree(
+            logits, drafts, tree, jnp.zeros((4,), bool), None)
+        assert int(n) == 0
+        assert int(np.asarray(out)[0]) == g[0]  # the correction draw
+
+
+class TestTreeServing:
+    @pytest.mark.parametrize(
+        "tree_fn", [chain_tree, offramp_tree],
+        ids=["chain", "offramp"])
+    def test_greedy_identity_both_tree_shapes(self, spec_setup,
+                                              tree_fn):
+        """Tree-verified greedy serving under 6-requests/2-slots churn
+        is token-identical to plain decode, for both tree shapes."""
+        prompts = spec_setup[3]
+        plain, _ = _batcher(spec_setup, spec=False)
+        ref = plain.run(_reqs(prompts))
+        b, _ = _batcher(spec_setup, tree=tree_fn(K))
+        got = b.run(_reqs(prompts))
+        for i in range(6):
+            uid = str(i)
+            assert got[uid].tokens == ref[uid].tokens, uid
+            assert got[uid].reason == ref[uid].reason, uid
+        assert b.spec_stats["accepted"] > 0
+
+    def test_seeded_sampled_identity_offramp(self, spec_setup):
+        """Seeded SAMPLED streams through the off-ramp tree equal
+        plain sampling's — the coupled walk preserves the per-slot
+        absolute-position key schedule exactly."""
+        prompts = spec_setup[3]
+        plain, _ = _batcher(spec_setup, spec=False, temperature=0.8)
+        ref = plain.run(_reqs(prompts, seed=100))
+        b, _ = _batcher(spec_setup, tree=offramp_tree(K),
+                        temperature=0.8)
+        got = b.run(_reqs(prompts, seed=100))
+        for i in range(6):
+            assert got[str(i)].tokens == ref[str(i)].tokens, i
+
+    def test_tree_shapes_never_change_jit_entries(self, spec_setup):
+        """Waves with different acceptance/tree-draft patterns change
+        CONTENTS, never shapes: zero jit growth after warmup."""
+        prompts = spec_setup[3]
+        b, fns = _batcher(spec_setup, tree=offramp_tree(K))
+        b.run(_reqs(prompts[:2]))
+        warm = fns.spec_jit._cache_size()
+        b.run(_reqs(prompts, tag="w2-"))
+        b.run(_reqs(list(reversed(prompts)), tag="w3-"))
+        assert fns.spec_jit._cache_size() == warm
+
+    def test_draft_source_rides_the_compiled_step(self, spec_setup):
+        """decode_fns(draft_model=...) stamps the source onto spec;
+        the batcher picks it up without an explicit draft_source."""
+        mesh, model, params, prompts, maxp = spec_setup
+        ds = NGramDraftSource(K)
+        b, fns = _batcher(spec_setup, draft_model=ds)
+        assert fns.draft_source is ds
+        assert b.draft_source is ds
+
+    def test_tree_mismatch_rejected(self, spec_setup):
+        """A draft source built for one tree cannot drive a spec step
+        compiled for another (or for a chain)."""
+
+        class _TreeSrc(NGramDraftSource):
+            tree = offramp_tree(K)
+
+        with pytest.raises(ValueError, match="tree"):
+            _batcher(spec_setup, tree=chain_tree(K),
+                     draft=_TreeSrc(K))
+        with pytest.raises(ValueError, match="tree"):
+            _batcher(spec_setup, draft=_TreeSrc(K))
+
+
+class TestModelDraftServing:
+    def _source(self, setup, tree=None):
+        mesh, model, params, prompts, maxp = setup
+        pps = -(-(maxp + NEW + K) // PAGE)
+        dcfg = KVCacheConfig(
+            num_layers=2, num_heads=4, head_dim=8,
+            num_pages=1 + 2 * pps, page_size=PAGE, max_seqs=2,
+            pages_per_seq=pps, dtype=jnp.float32)
+        # weight_block=16: the tiny model's fused qkv rows (96) must
+        # tile 2*block for the packed int4 halves
+        return ModelDraftSource(model, params, mesh, dcfg, k=K,
+                                tree=tree, weight_dtype="int4",
+                                weight_block=16)
+
+    def test_greedy_identity_with_draft_model(self, spec_setup):
+        """A real int4 draft model drafting into the verify step keeps
+        greedy serving token-identical to plain decode — and actually
+        accepts (the draft model IS the target here, quantized)."""
+        prompts = spec_setup[3]
+        plain, _ = _batcher(spec_setup, spec=False)
+        ref = plain.run(_reqs(prompts))
+        b, _ = _batcher(spec_setup, draft_model=self._source(
+            spec_setup))
+        got = b.run(_reqs(prompts))
+        for i in range(6):
+            uid = str(i)
+            assert got[uid].tokens == ref[uid].tokens, uid
+            assert got[uid].reason == ref[uid].reason, uid
+        st = b.spec_stats
+        assert st["by_source"]["draft_model"]["accepted"] > 0
+        assert st["draft_s"] > 0.0
+
+    def test_tree_draft_model_identity_and_stream_bytes(
+            self, spec_setup):
+        """Off-ramp tree drafting from the int4 draft model: identity
+        holds and the draft's weight stream is a fraction of the
+        full-precision pool's."""
+        prompts = spec_setup[3]
+        plain, _ = _batcher(spec_setup, spec=False)
+        ref = plain.run(_reqs(prompts))
+        ds = self._source(spec_setup, tree=offramp_tree(K))
+        b, fns = _batcher(spec_setup, tree=offramp_tree(K),
+                          draft_model=ds)
+        got = b.run(_reqs(prompts))
+        for i in range(6):
+            assert got[str(i)].tokens == ref[str(i)].tokens, i
+        assert ds.weight_dtype == "int4"
+        assert ds.weight_stream_bytes < fns.weight_stream_bytes
+
+    def test_draft_is_pure_function_of_context(self, spec_setup):
+        """Drafting twice from the same context — cold and through the
+        per-slot KV memoization — returns identical tokens (the
+        failover-replay requirement)."""
+        ds = self._source(spec_setup, tree=offramp_tree(K))
+        ctx = [3, 7, 11, 5, 3, 7, 11, 5, 3, 7]
+        first, src = ds.draft(ctx, len(ctx))
+        assert src == "draft_model" and len(first) == 2 * K
+        again, _ = ds.draft(ctx, len(ctx))          # memoized prefix
+        assert again == first
+        cold = self._source(spec_setup, tree=offramp_tree(K))
+        fresh, _ = cold.draft(ctx, len(ctx))
+        assert fresh == first
 
 
 # ---------------------------------------------------------------------------

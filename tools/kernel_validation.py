@@ -1323,6 +1323,86 @@ def validate_fmha_decode(smoke=False):
             })
             print(json.dumps(results[-1]))
 
+    # ---- tree-verify cells: ancestor-masked s_q in {4, 8, 16} — the
+    # TREE speculation shape (docs/attention.md fourth rung).  The
+    # verify rows stop being one chain: a static (sq, sq) ancestor
+    # matrix over the candidate tree replaces the in-window causal
+    # triangle, so each row attends the committed cache plus exactly
+    # its root-to-node path.  Heap-shaped trees (parents[r] =
+    # (r-1)//2) give real branching at every depth; the dense XLA
+    # reference runs under the SAME mask.  Ragged lengths and shuffled
+    # page tables as everywhere; same parity gate (1) and
+    # never-lose-to-XLA gate (2).
+    tsqs = [8] if smoke else [4, 8, 16]
+    for sq in tsqs:
+        ancestor_tree = tuple(-1 if r == 0 else (r - 1) // 2
+                              for r in range(sq))
+        b, cache = 8, (512 if smoke else 2048)
+        npp = cache // ps
+        pool_pages = 1 + b * npp
+        key = jax.random.PRNGKey(3000 + sq)
+        k0, k1, k2, k3 = jax.random.split(key, 4)
+        km = jax.random.normal(k0, (pool_pages, h, ps, d), jnp.bfloat16)
+        vm = jax.random.normal(k1, (pool_pages, h, ps, d), jnp.bfloat16)
+        q = jax.random.normal(k2, (b, h, sq, d), jnp.bfloat16)
+        perm = jax.random.permutation(
+            k3, jnp.arange(1, pool_pages, dtype=jnp.int32))
+        page_table = perm[: b * npp].reshape(b, npp)
+        lengths = jnp.where(
+            jnp.arange(b) % 2 == 0, cache, cache - ps // 2 - 1
+        ).astype(jnp.int32)
+        from apex_tpu.serving.speculate import tree_ancestors
+
+        amask = tree_ancestors(ancestor_tree)
+        kwargs = dict(kv_block=kv_block, ancestor=amask)
+
+        def fwd_t(impl):
+            return jax.jit(
+                lambda q, kp, vp: jnp.sum(fmha_decode(
+                    q, kp, vp, page_table, lengths,
+                    implementation=impl, **kwargs,
+                ).astype(jnp.float32)))
+
+        with jax.default_matmul_precision("highest"):
+            ref = jax.jit(
+                lambda q, kr, vr: paged_attention_reference(
+                    q, kr, vr, page_table, lengths, ancestor=amask))(
+                q.astype(jnp.float32), km.astype(jnp.float32),
+                vm.astype(jnp.float32))
+        out_p = jax.device_get(jax.jit(
+            lambda q, kp, vp: fmha_decode(
+                q, kp, vp, page_table, lengths,
+                implementation="pallas", **kwargs))(q, km, vm))
+        out_x = jax.device_get(jax.jit(
+            lambda q, kp, vp: fmha_decode(
+                q, kp, vp, page_table, lengths,
+                implementation="xla", **kwargs))(q, km, vm))
+        iters = 10 if smoke else 50
+        p_ms = _time(fwd_t("pallas"), q, km, vm, iters=iters)
+        x_ms = _time(fwd_t("xla"), q, km, vm, iters=iters)
+        kv_bytes = 2 * b * npp * ps * h * d * \
+            jnp.dtype(km.dtype).itemsize
+        results.append({
+            "kernel": "fmha_decode",
+            "shape": [b, h, sq, d],
+            "cache_len": cache,
+            "page_size": ps,
+            "dtype": "bfloat16",
+            "causal": True,
+            "auto_impl": "pallas",
+            "tree_verify": True,
+            "fwd": {
+                "pallas_ms": round(p_ms, 3),
+                "xla_ms": round(x_ms, 3),
+                "speedup": round(x_ms / p_ms, 2),
+                "decode_gbs": round(
+                    kv_bytes / (p_ms * 1e-3) / 1e9, 1),
+                "max_err_vs_fp32": _max_err(out_p, ref),
+                "xla_err_vs_fp32": _max_err(out_x, ref),
+            },
+        })
+        print(json.dumps(results[-1]))
+
     # ---- head-sharded cells: the tensor-parallel decode layout.  A
     # tp shard calls fmha_decode on its OWN head slice of the pool
     # ((pages, h/tp, ps, d) — heads are independent in attention, so

@@ -529,7 +529,7 @@ def _serve_kv_pool_bytes(layers, heads, head_dim, *, max_seqs,
 
 def run_serve_audit(hbm_gb=DEFAULT_HBM_GB, max_seqs=4, context=1024,
                     page_size=64, block=128,
-                    tp=SERVE_TP_DEGREES) -> dict:
+                    tp=SERVE_TP_DEGREES, draft_tier="1B") -> dict:
     """The --serve document: per-device decode-path bytes (weight pool
     + KV pool + decode activations) for every tier x weight width,
     and the largest tier that fits per width.  KV rides int8 (the
@@ -545,7 +545,15 @@ def run_serve_audit(hbm_gb=DEFAULT_HBM_GB, max_seqs=4, context=1024,
     under the int4 per-shard packing rules reports ``fits_hbm: null``
     with the builder's own error as the note.  Tiers that fit NO width
     single-chip but fit some (width, tp) shard land in
-    ``fits_only_tensor_parallel`` — the 70B row is the headline."""
+    ``fits_only_tensor_parallel`` — the 70B row is the headline.
+
+    ``draft_tier`` (a tier name, default "1B"; None disables) audits
+    model-based speculation co-residency: the draft model's int4
+    weight pool + its own int8 paged-KV slice (the
+    ``ModelDraftSource`` serving state) are priced ONCE and added to
+    every target width row as a ``with_draft`` verdict — the draft is
+    replicated per tp shard (it is tiny and drafts on one chip), so
+    tp sub-rows add the full draft bytes."""
     import jax.numpy as jnp
 
     from apex_tpu.models import GPTConfig, GPTModel
@@ -553,6 +561,31 @@ def run_serve_audit(hbm_gb=DEFAULT_HBM_GB, max_seqs=4, context=1024,
     hbm = hbm_gb * 1e9
     tiers = []
     largest_fit = {w: None for w in WEIGHT_WIDTHS}
+    draft = None
+    largest_fit_draft = {w: None for w in WEIGHT_WIDTHS}
+    if draft_tier is not None:
+        dshape = dict(SERVE_TIERS)[draft_tier]
+        dmodel = GPTModel(GPTConfig(
+            vocab_size=dshape["vocab"], num_layers=dshape["layers"],
+            hidden_size=dshape["hidden"],
+            num_attention_heads=dshape["heads"],
+            max_position_embeddings=context,
+            position_embedding="rope", compute_dtype=jnp.float32,
+            remat=False, attention_impl="xla",
+        ))
+        draft = {
+            "tier": draft_tier,
+            "weight_width": "int4",
+            "weight_pool_bytes": _serve_weight_pool_bytes(
+                dmodel, "int4", block),
+            "kv_pool_bytes": _serve_kv_pool_bytes(
+                dshape["layers"], dshape["heads"],
+                dshape["hidden"] // dshape["heads"],
+                max_seqs=max_seqs, context=context,
+                page_size=page_size, kv_dtype=jnp.int8),
+        }
+        draft["total_bytes"] = (draft["weight_pool_bytes"]
+                                + draft["kv_pool_bytes"])
     for name, shape in SERVE_TIERS:
         head_dim = shape["hidden"] // shape["heads"]
         model = GPTModel(GPTConfig(
@@ -590,6 +623,14 @@ def run_serve_audit(hbm_gb=DEFAULT_HBM_GB, max_seqs=4, context=1024,
             }
             if fits:
                 largest_fit[w] = name     # tiers ascend in size
+            if draft is not None:
+                dtot = total + draft["total_bytes"]
+                row["widths"][w]["with_draft"] = {
+                    "total_bytes": dtot,
+                    "fits_hbm": bool(dtot < hbm),
+                }
+                if dtot < hbm:
+                    largest_fit_draft[w] = name
             tp_rows = {}
             for t in tp or ():
                 if shape["heads"] % t:
@@ -614,6 +655,13 @@ def run_serve_audit(hbm_gb=DEFAULT_HBM_GB, max_seqs=4, context=1024,
                     "per_shard_total_bytes": totals,
                     "fits_hbm": bool(totals < hbm),
                 }
+                if draft is not None:
+                    # the draft rides every shard in full (replicated)
+                    dtp = totals + draft["total_bytes"]
+                    tp_rows[str(t)]["with_draft"] = {
+                        "per_shard_total_bytes": dtp,
+                        "fits_hbm": bool(dtp < hbm),
+                    }
             if tp_rows:
                 row["widths"][w]["tp"] = tp_rows
         tiers.append(row)
@@ -650,6 +698,11 @@ def run_serve_audit(hbm_gb=DEFAULT_HBM_GB, max_seqs=4, context=1024,
         "tiers": tiers,
         "fits_only_quantized": only_quant,
         "fits_only_tensor_parallel": only_tp,
+        **({} if draft is None else {
+            "draft": draft,
+            "draft_co_resident_largest_fit": {
+                w: largest_fit_draft[w] for w in WEIGHT_WIDTHS},
+        }),
     }
 
 
@@ -687,6 +740,12 @@ def main():
                     help="--serve: tensor-parallel degree for "
                          "per-shard verdict rows (repeatable; "
                          "default: 2 and 4)")
+    ap.add_argument("--draft-tier", default="1B",
+                    choices=[n for n, _ in SERVE_TIERS] + ["none"],
+                    help="--serve: co-resident draft-model tier for "
+                         "the speculation verdict (int4 pool + its "
+                         "own int8 KV slice added to every width "
+                         "row; 'none' disables)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     _force_virtual_devices(args.devices)
@@ -696,7 +755,9 @@ def main():
             hbm_gb=args.hbm_gb, max_seqs=args.max_seqs,
             context=args.context, page_size=args.page_size,
             block=args.weight_block,
-            tp=tuple(args.tp) if args.tp else SERVE_TP_DEGREES)
+            tp=tuple(args.tp) if args.tp else SERVE_TP_DEGREES,
+            draft_tier=(None if args.draft_tier == "none"
+                        else args.draft_tier))
         root = os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))
         out_path = args.out or os.path.join(
@@ -709,6 +770,13 @@ def main():
             "fits_only_quantized": doc["fits_only_quantized"],
             "fits_only_tensor_parallel":
                 doc["fits_only_tensor_parallel"],
+            **({} if "draft" not in doc else {
+                "draft_tier": doc["draft"]["tier"],
+                "draft_gb": round(doc["draft"]["total_bytes"] / gb,
+                                  3),
+                "draft_co_resident_largest_fit":
+                    doc["draft_co_resident_largest_fit"],
+            }),
             "tiers_gb": {
                 r["tier"]: {
                     w: round(r["widths"][w]["total_bytes"] / gb, 2)

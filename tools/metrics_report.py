@@ -206,10 +206,19 @@ def summarize_serving(records: List[dict]) -> Optional[Dict[str, Any]]:
         accepted = sum(int(r.get("accepted", 0)) for r in spec)
         committed = sum(int(r.get("committed", 0)) for r in spec)
         slot_steps = sum(len(r.get("commits", [])) for r in spec)
+        offramp = sum(int(r.get("offramp", 0)) for r in spec)
+        # commits-per-slot-step doubles as the committed TREE DEPTH
+        # histogram (a commit of n is a depth-(n-1) accepted path plus
+        # its correction/bonus draw)
         hist: Dict[str, int] = {}
         for r in spec:
             for nc in r.get("commits", []):
                 hist[str(int(nc))] = hist.get(str(int(nc)), 0) + 1
+        # draft-model host cost: the speculative decode spans stamp
+        # the wall seconds spent inside draft() (dur_s includes it, so
+        # the ratio is the draft's fraction of the serving wall)
+        draft_wall = sum(float(r.get("draft_s", 0.0)) for r in decode)
+        spec_wall = sum(float(r.get("dur_s", 0.0)) for r in decode)
         by_source: Dict[str, Dict[str, Any]] = {}
         for r in spec:
             for src, rec in (r.get("by_source") or {}).items():
@@ -236,6 +245,12 @@ def summarize_serving(records: List[dict]) -> Optional[Dict[str, Any]]:
             "wasted_verify_fraction": (
                 round((drafted - accepted) / drafted, 4)
                 if drafted else None),
+            # commits that rode a non-spine tree branch — every one is
+            # a token the chain verifier would have rejected
+            "offramp_commits": offramp,
+            "draft_wall_s": round(draft_wall, 6),
+            "draft_wall_fraction": (
+                round(draft_wall / spec_wall, 4) if spec_wall > 0 else None),
             "by_source": by_source,
         }
     if done:
@@ -704,7 +719,13 @@ def format_report(summary: Dict[str, Any]) -> str:
             if sp.get("wasted_verify_fraction") is not None:
                 row += (f", wasted-verify "
                         f"{sp['wasted_verify_fraction']:.0%}")
+            if sp.get("offramp_commits"):
+                row += f", {sp['offramp_commits']} off-ramp commits"
             lines.append(row)
+            if sp.get("draft_wall_fraction") is not None:
+                lines.append(
+                    f"    draft model cost: {sp['draft_wall_s']:.3f} s "
+                    f"({sp['draft_wall_fraction']:.0%} of decode wall)")
             if sp.get("accepted_per_step_hist"):
                 hist = "  ".join(
                     f"{k}:{v}" for k, v in sorted(
