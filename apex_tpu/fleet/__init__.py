@@ -12,7 +12,12 @@ router.  Two modules, one concern each:
   priority queueing and admission control, prefix-affinity routing
   keyed on the prefix cache's cumulative page hashes, least-loaded
   fallback scored from host-mirror load signals (free pages, queue
-  depth, live slots — no new host syncs), and a round-robin baseline.
+  depth, live slots — no new host syncs), a round-robin baseline, and
+  DISAGGREGATED replica roles (``FleetPolicy.roles``): prefill-role
+  replicas ingest prompts and hand decode-ready streams to
+  decode-role replicas by moving KV pages
+  (``serving.kv_cache.export_pages``/``import_pages``), a journaled
+  ownership transfer that keeps streams token-identical.
 - :mod:`~apex_tpu.fleet.failover` — the replayable
   :class:`RequestLog` and :func:`resume_request`: every request's
   (prompt, seed, committed tokens) survives its replica, so a killed

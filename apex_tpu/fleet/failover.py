@@ -75,6 +75,10 @@ class LogEntry:
     deadline: Optional[float] = None
     deadline_rel: Optional[float] = None
     deadline_retries: int = 0
+    #: ownership transfers that MOVED pages instead of replaying
+    #: tokens (disaggregated prefill→decode handoffs) — unlike
+    #: ``replays``, a handoff converts nothing to prompt suffix
+    handoffs: int = 0
 
 
 class RequestLog:
@@ -164,6 +168,17 @@ class RequestLog:
         e.replayed = list(e.emitted)
         e.replica = replica
         e.replays += 1
+
+    def handoff(self, uid: Any, replica: str) -> None:
+        """Move an entry to a new holder by PAGE handoff: the KV moved,
+        so nothing converts to prompt suffix — ``replayed`` is
+        untouched, and the destination's ``progress()`` keeps reporting
+        the full post-replay stream (its imported slot is seeded with
+        exactly ``emitted[len(replayed):]``).  Contrast
+        :meth:`reassign`, the recompute path."""
+        e = self._entries[uid]
+        e.replica = replica
+        e.handoffs += 1
 
     def entries(self):
         """Every entry, admission order — what the durable journal

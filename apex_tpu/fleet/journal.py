@@ -154,6 +154,19 @@ class RequestJournal:
         self._state[req.uid] = 0
         self.flush()
 
+    def handoff(self, uid: Any, src: str, dst: str) -> None:
+        """Journal a page-level ownership transfer write-ahead: the
+        record lands BEFORE any pages move, so a crash mid-transfer
+        recovers a request that is at worst back on the recompute path
+        (its admit + progress records still replay the stream
+        token-identically).  Recovery ignores the record itself —
+        replica names do not survive a restart — it exists for the
+        durability ordering and for post-mortem forensics."""
+        self._buf.append(_seal({
+            "k": "handoff", "uid": uid, "src": str(src),
+            "dst": str(dst)}))
+        self.flush()
+
     def sync(self, log: Any) -> None:
         """Fold the in-memory :class:`RequestLog` into the journal:
         one progress/terminal delta per entry that moved, ONE batched

@@ -103,6 +103,11 @@ __all__ = ["SLOClass", "FleetPolicy", "BrownoutPolicy", "Replica",
 
 _ROUTINGS = ("affinity", "least_loaded", "round_robin")
 
+#: replica roles: ``prefill`` ingests prompts and hands decode-ready
+#: streams off by page movement, ``decode`` receives streams only by
+#: handoff, ``unified`` does both (the pre-disaggregation behavior)
+_REPLICA_ROLES = ("prefill", "decode", "unified")
+
 
 @dataclasses.dataclass(frozen=True)
 class SLOClass:
@@ -230,12 +235,38 @@ class FleetPolicy:
     max_replica_faults: int = 3
     #: the degradation ladder (None = no brownout behavior)
     brownout: Optional[BrownoutPolicy] = None
+    #: per-replica roles by INDEX (``"prefill"`` / ``"decode"`` /
+    #: ``"unified"``); None = all unified.  Any non-unified role makes
+    #: the fleet DISAGGREGATED: prompts route to prefill-capable
+    #: replicas only, and finished prefills hand their KV pages off to
+    #: decode-capable replicas (:meth:`FleetRouter._handoff_sweep`) —
+    #: prefill compute and decode weight-streaming stop stealing each
+    #: other's step budget
+    roles: Optional[Tuple[str, ...]] = None
 
     def __post_init__(self):
         if self.routing not in _ROUTINGS:
             raise ValueError(
                 f"routing must be one of {_ROUTINGS}, "
                 f"got {self.routing!r}")
+        if self.roles is not None:
+            bad = [x for x in self.roles if x not in _REPLICA_ROLES]
+            if bad:
+                raise ValueError(
+                    f"unknown replica roles {bad} — roles must be "
+                    f"among {_REPLICA_ROLES}")
+            if "prefill" in self.roles and not any(
+                    x in ("decode", "unified") for x in self.roles):
+                raise ValueError(
+                    "prefill-role replicas hand every stream off — "
+                    "the fleet needs at least one decode-capable "
+                    "(decode or unified) replica")
+            if "decode" in self.roles and not any(
+                    x in ("prefill", "unified") for x in self.roles):
+                raise ValueError(
+                    "pure-decode replicas receive work only by page "
+                    "handoff — the fleet needs at least one "
+                    "prefill-capable replica")
         if not self.classes:
             raise ValueError("policy needs at least one SLO class")
         names = [c.name for c in self.classes]
@@ -267,9 +298,17 @@ class Replica:
     additionally loses the unharvested window, which the replay
     contract already treats as uncommitted)."""
 
-    def __init__(self, name: str, batcher: ContinuousBatcher):
+    def __init__(self, name: str, batcher: ContinuousBatcher,
+                 role: str = "unified"):
+        if role not in _REPLICA_ROLES:
+            raise ValueError(
+                f"unknown replica role {role!r} — must be among "
+                f"{_REPLICA_ROLES}")
         self.name = str(name)
         self.batcher = batcher
+        #: disaggregation role (``FleetPolicy.roles`` overrides it at
+        #: router construction)
+        self.role = role
         self.alive = True
         self.windows = 0
         self.fail_at: Optional[int] = None
@@ -310,6 +349,9 @@ class FleetCompletion:
     #: True when a hedged duplicate won the race (the stream is still
     #: token-identical — determinism is why hedging is safe at all)
     hedged: bool = False
+    #: page-level ownership transfers the request rode (disaggregated
+    #: prefill→decode moves — no recompute, unlike ``replays``)
+    handoffs: int = 0
 
     @property
     def itl_ms(self) -> Optional[float]:
@@ -377,6 +419,32 @@ class FleetRouter:
                 "the routing key is per-page, all replicas must share "
                 "one cache config family")
         self.policy = policy if policy is not None else FleetPolicy()
+        if self.policy.roles is not None:
+            if len(self.policy.roles) != len(self.replicas):
+                raise ValueError(
+                    f"policy.roles names {len(self.policy.roles)} "
+                    f"replicas but the fleet has "
+                    f"{len(self.replicas)}")
+            for r, role in zip(self.replicas, self.policy.roles):
+                r.role = role
+        #: any non-unified role => disaggregated scheduling: role-aware
+        #: routing plus the per-step handoff sweep
+        self._disagg = any(r.role != "unified" for r in self.replicas)
+        if self._disagg:
+            fams = {r.batcher.cache.compat_key()
+                    for r in self.replicas}
+            if len(fams) != 1:
+                raise ValueError(
+                    "disaggregated fleets move KV pages between "
+                    "replicas — every cache must share one page "
+                    f"layout (compat_key), got {len(fams)} distinct")
+            for r in self.replicas:
+                if r.role == "prefill":
+                    r.batcher.decode_enabled = False
+        #: staged handoff packets awaiting destination capacity:
+        #: {"uid", "src", "dst", "packet", "export_s", "replays",
+        #: "handoffs"} — charged to the DESTINATION's load score only
+        self._handoffs: List[dict] = []
         self.logger = logger
         self._clock = clock
         self.journal = journal
@@ -410,6 +478,7 @@ class FleetRouter:
             "deadline_misses": 0, "deadline_retries": 0,
             "hedges": 0, "hedge_wins": 0, "hedge_losses": 0,
             "brownout_transitions": 0, "resumed_from_journal": 0,
+            "handoffs": 0, "handoff_pages": 0, "handoff_bytes": 0,
             "routed": {r.name: 0 for r in self.replicas},
         }
 
@@ -434,15 +503,26 @@ class FleetRouter:
                     n += 1
         return n
 
+    def _inbound(self, name: str) -> int:
+        """Staged handoff packets bound for the named replica — load
+        it has accepted ownership of but not yet imported."""
+        return sum(1 for p in self._handoffs if p["dst"] == name)
+
     def _load(self, r: Replica) -> float:
         """Host-mirror load score — the telemetry-gauge quantities,
-        read directly (no device sync, no jsonl round-trip)."""
+        read directly (no device sync, no jsonl round-trip).  A
+        mid-handoff request counts against its DESTINATION only (the
+        ``_inbound`` term): the source released its slot at export, so
+        without the term the request would vanish from every score
+        while staged — and with the old holder-based accounting it was
+        counted on BOTH sides until the import landed."""
         p = self.policy
         cfg = r.batcher.cache.config
         free_frac = (r.batcher.cache.allocator.num_free
                      / max(1, cfg.num_pages - 1))
         return (p.w_queue * len(self._queues[r.name])
-                + p.w_slots * r.batcher.live_slots
+                + p.w_slots * (r.batcher.live_slots
+                               + self._inbound(r.name))
                 - p.w_pages * free_frac)
 
     # ------------------------------------------------------------- route
@@ -453,16 +533,26 @@ class FleetRouter:
         alive = [r for r in self.replicas if r.alive]
         if not alive:
             raise RuntimeError("no replica is alive")
+        # disaggregation: prompts go to prefill-capable replicas; a
+        # pure-decode replica receives work by page handoff, never by
+        # routing — unless nothing prefill-capable is left alive
+        cands = [r for r in alive if r.role != "decode"] or alive
         if self.policy.routing == "round_robin":
-            r = alive[self._rr % len(alive)]
+            r = cands[self._rr % len(cands)]
             self._rr += 1
             return r, 0
         key = (prompt_page_hashes(request.prompt, self._page_size)
                if self.policy.routing == "affinity" else [])
         best, best_score, best_aff = None, None, 0
-        for i, r in enumerate(alive):
+        for i, r in enumerate(cands):
             aff = r.batcher.cache.match_len(key) if key else 0
-            score = (-aff, self._load(r), i)
+            # chunk budget: in a disaggregated fleet, prompts steer by
+            # the chunks a prefill replica still owes, not just queue
+            # length — the prefill-pressure half of role-aware routing
+            pressure = (self.policy.w_queue
+                        * r.batcher.pending_prefill_chunks
+                        if self._disagg else 0.0)
+            score = (-aff, self._load(r) + pressure, i)
             if best_score is None or score < best_score:
                 best, best_score, best_aff = r, score, aff
         return best, best_aff
@@ -606,6 +696,7 @@ class FleetRouter:
             if self.policy.pump_timeout_s is not None \
                     and dur > self.policy.pump_timeout_s:
                 self._quarantine(r, "stall")
+        self._handoff_sweep()
         self._enforce_deadlines()
         self._spawn_hedges()
         if self.journal is not None:
@@ -706,6 +797,7 @@ class FleetRouter:
                     prompt_len=len(e.request.prompt),
                     reason=e.reason, slo=e.slo, replica=r.name,
                     replays=e.replays, hedged=True,
+                    handoffs=e.handoffs,
                     ttft_s=(None if e.t_first is None
                             else e.t_first - e.t_arrive),
                     duration_s=now - e.t_arrive,
@@ -721,13 +813,119 @@ class FleetRouter:
                 uid=uid, tokens=list(e.emitted),
                 prompt_len=len(e.request.prompt),
                 reason=e.reason, slo=e.slo, replica=r.name,
-                replays=e.replays,
+                replays=e.replays, handoffs=e.handoffs,
                 ttft_s=(None if e.t_first is None
                         else e.t_first - e.t_arrive),
                 duration_s=now - e.t_arrive,
             )
             if uid in self._hedges:
                 self._drop_hedge(uid, "primary_won")
+
+    # ----------------------------------------------------------- handoff
+    def _decode_target(self) -> Optional[Replica]:
+        """The least-loaded decode-capable replica (pure decode
+        preferred over unified — that is what the role exists for);
+        None when nothing decode-capable is alive."""
+        best, best_score = None, None
+        for i, r in enumerate(self.replicas):
+            if not r.alive or r.role == "prefill":
+                continue
+            score = (0 if r.role == "decode" else 1, self._load(r), i)
+            if best_score is None or score < best_score:
+                best, best_score = r, score
+        return best
+
+    def _handoff_sweep(self) -> None:
+        """The disaggregation engine, once per fleet step AFTER every
+        pump+absorb (so the log's ``emitted`` and the packet's tokens
+        agree): export decode-ready streams off prefill replicas as
+        staged :class:`~apex_tpu.serving.serve.HandoffPacket`\\ s —
+        each a journaled ownership transfer — then land staged packets
+        on their destination as capacity allows (same step when the
+        destination has a free slot).  The contract end to end:
+
+        - **durability first**: the journal's ``handoff`` record is
+          written BEFORE any pages move, and the packet's tokens are
+          already journaled progress — a crash at any point recovers
+          the stream token-identically (at worst via recompute).
+        - **no double-count**: the source slot is released at export;
+          the staged packet charges the destination's load score via
+          ``_inbound`` until imported.
+        - **staleness**: a packet whose log entry completed, changed
+          holder (deadline retry, dead-destination migration) or
+          advanced its replay/handoff counters is dropped — the
+          recompute path owns the request; page content is always
+          regenerable.
+        - **fallback**: with every decode-capable replica dead, the
+          prefill replicas flip ``decode_enabled`` back on (one-way,
+          ``role_fallback`` event) so streams still finish."""
+        if not self._disagg:
+            return
+        if not any(r.alive and r.role != "prefill"
+                   for r in self.replicas):
+            for r in self.replicas:
+                if r.alive and not r.batcher.decode_enabled:
+                    r.batcher.decode_enabled = True
+                    self._event("role_fallback", replica=r.name)
+            return
+        # ---- export: prefill replicas shed decode-ready streams
+        for r in self.replicas:
+            if not r.alive or r.role != "prefill" \
+                    or r.batcher.decode_enabled:
+                continue    # decode_enabled: a past fallback flipped it
+            for uid in r.batcher.handoff_ready():
+                if uid not in self.log:
+                    continue
+                e = self.log.get(uid)
+                if e.done or e.replica != r.name:
+                    continue    # a hedge duplicate — never exported
+                dst = self._decode_target()
+                if dst is None:
+                    return
+                if (self._inbound(dst.name)
+                        >= dst.batcher.cache.config.max_seqs):
+                    continue    # staging bounded by destination slots
+                if self.journal is not None:
+                    self.journal.handoff(uid, r.name, dst.name)
+                t0 = self._clock()
+                packet = r.batcher.export_request(uid)
+                if packet is None:
+                    continue
+                self.log.handoff(uid, dst.name)
+                self._handoffs.append({
+                    "uid": uid, "src": r.name, "dst": dst.name,
+                    "packet": packet,
+                    "export_s": self._clock() - t0,
+                    "replays": e.replays, "handoffs": e.handoffs,
+                })
+        # ---- import: land staged packets where capacity allows
+        for pk in list(self._handoffs):
+            uid = pk["uid"]
+            e = self.log.get(uid) if uid in self.log else None
+            if e is None or e.done or e.replica != pk["dst"] \
+                    or e.replays != pk["replays"] \
+                    or e.handoffs != pk["handoffs"]:
+                # completed / cancelled / re-routed since staging: the
+                # packet is stale, the recompute path owns the request
+                self._handoffs.remove(pk)
+                continue
+            dst = self._by_name.get(pk["dst"])
+            if dst is None or not dst.alive:
+                continue    # the migration pass re-routes next step
+            t0 = self._clock()
+            if not dst.batcher.import_request(pk["packet"]):
+                continue                # backpressure: stay staged
+            self._handoffs.remove(pk)
+            self.stats["handoffs"] += 1
+            self.stats["handoff_pages"] += pk["packet"].n_pages
+            self.stats["handoff_bytes"] += pk["packet"].wire_bytes
+            self._event(
+                "kv_handoff", uid=uid, src=pk["src"], dst=pk["dst"],
+                pages=pk["packet"].n_pages,
+                bytes=pk["packet"].wire_bytes,
+                tokens=len(pk["packet"].tokens),
+                dur_s=round(pk["export_s"]
+                            + (self._clock() - t0), 6))
 
     # --------------------------------------------------------- deadlines
     def _cancel_everywhere(self, e) -> Optional[List[int]]:
@@ -792,7 +990,7 @@ class FleetRouter:
                     uid=uid, tokens=list(e.emitted),
                     prompt_len=len(e.request.prompt),
                     reason="deadline", slo=e.slo, replica=e.replica,
-                    replays=e.replays,
+                    replays=e.replays, handoffs=e.handoffs,
                     ttft_s=(None if e.t_first is None
                             else e.t_first - e.t_arrive),
                     duration_s=now - e.t_arrive,
@@ -821,7 +1019,12 @@ class FleetRouter:
             if cls.hedge_after_s is None \
                     or now - e.t_arrive < cls.hedge_after_s:
                 continue
-            cands = [r for r in alive if r.name != e.replica]
+            # never hedge onto a prefill-role replica: it would ingest
+            # the replay and then wait for a handoff the sweep refuses
+            # (hedge copies are not log holders) — a slot burned for
+            # nothing
+            cands = [r for r in alive if r.name != e.replica
+                     and r.role != "prefill"]
             if not cands:
                 continue
             try:

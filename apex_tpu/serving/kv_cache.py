@@ -46,9 +46,11 @@ eviction is pure refcount GC.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -58,11 +60,15 @@ __all__ = [
     "AdmitResult",
     "PageAllocator",
     "PagedKVCache",
+    "HostOffloadPool",
     "prompt_page_hashes",
     "init_pools",
     "write_tokens",
     "write_targets",
     "copy_pages",
+    "export_pages",
+    "import_pages",
+    "staged_nbytes",
 ]
 
 
@@ -283,6 +289,19 @@ class PagedKVCache:
         # the slot's lifetime — eviction or reuse before the copy would
         # silently corrupt the clone)
         self._extra_refs: Dict[int, List[int]] = {}
+        # the offload seam: called ONCE per GC burst as
+        # ``evict_hook(victims)`` with the list of ``(hash,
+        # parent_hash, page)`` index-only entries the refcount GC is
+        # about to free, BEFORE any page is freed — device content is
+        # still valid, so the hook may stage the whole batch to a host
+        # tier (:class:`HostOffloadPool`) with one device->host
+        # transfer.  The hook must not allocate or evict (it runs
+        # inside ``_evict_prefix``).  When a hook is attached the GC
+        # over-evicts to ``evict_batch`` victims per burst (the extras
+        # are recoverable from the host tier) so staging amortizes.
+        self.evict_hook: Optional[Callable[
+            [List[Tuple[bytes, Optional[bytes], int]]], None]] = None
+        self.evict_batch: int = 8
 
     # ------------------------------------------------------ prefix index
     def _page_hashes(self, prompt_tokens) -> List[bytes]:
@@ -310,27 +329,73 @@ class PagedKVCache:
             n += 1
         return n * self.config.page_size
 
-    def _evict_prefix(self, n: int) -> int:
+    def _evict_prefix(self, n: int, protect=()) -> int:
         """Refcount GC: unregister up to ``n`` index entries whose page
         the index is the ONLY holder of (leaf entries first — an inner
         entry stays while a longer chain built on it survives), freeing
-        their pages.  Returns how many pages were freed."""
+        their pages.  Returns how many pages were freed.
+
+        ``protect`` is a collection of hashes the GC must skip — the
+        fault-in path uses it so re-adopting page ``k`` of a chain can
+        never evict pages ``< k`` it just brought back.  The victim
+        batch is offered to :attr:`evict_hook` (one call per burst)
+        before any page is freed; with a hook attached the burst is
+        padded up to :attr:`evict_batch` victims so the hook's
+        device->host staging amortizes — the extras live on in the
+        host tier, not lost."""
+        if self.evict_hook is not None:
+            n = max(n, self.evict_batch)
         freed, progress = 0, True
+        protect = set(protect)
+        victims: List[Tuple[bytes, Optional[bytes], int]] = []
         while freed < n and progress:
             progress = False
             for h in list(self._prefix):
+                if h in protect:
+                    continue
                 e = self._prefix[h]
                 if e["children"] == 0 and \
                         self.allocator.refcount(e["page"]) == 1:
+                    victims.append((h, e["parent"], e["page"]))
                     del self._prefix[h]
                     if e["parent"] is not None:
                         self._prefix[e["parent"]]["children"] -= 1
-                    self.allocator.free([e["page"]])
                     freed += 1
                     progress = True
                     if freed >= n:
                         break
+        if victims:
+            if self.evict_hook is not None:
+                self.evict_hook(victims)
+            self.allocator.free([p for _, _, p in victims])
         return freed
+
+    def adopt_prefix_page(self, h: bytes, parent: Optional[bytes],
+                          protect=()) -> int:
+        """Allocate one page and register it in the prefix index under
+        hash ``h`` with the index as its only holder — the fault-in
+        half of the offload tier: the caller then scatters the staged
+        host bytes into the returned physical page
+        (:func:`import_pages`), after which the chain is
+        indistinguishable from one that never left the device.  Runs
+        the refcount GC (honoring ``protect``) when the pool is out of
+        free pages; raises :class:`CacheOutOfPages` if nothing can be
+        evicted.  ``parent`` must already be indexed (fault in a chain
+        oldest-first) or ``None`` for the chain head."""
+        if h in self._prefix:
+            raise ValueError("hash already indexed — probe before "
+                             "adopting")
+        if parent is not None and parent not in self._prefix:
+            raise ValueError("parent hash not indexed — fault a chain "
+                             "in oldest-first")
+        short = 1 - self.allocator.num_free
+        if short > 0:
+            self._evict_prefix(short, protect=protect)
+        page = self.allocator.alloc(1)[0]
+        self._prefix[h] = {"page": page, "parent": parent, "children": 0}
+        if parent is not None:
+            self._prefix[parent]["children"] += 1
+        return page
 
     def register_prefix(self, slot: int, prompt_tokens,
                         hashes: Optional[List[bytes]] = None) -> int:
@@ -453,6 +518,19 @@ class PagedKVCache:
     def active_slots(self) -> List[int]:
         return sorted(self._slot_pages)
 
+    def compat_key(self) -> Tuple:
+        """The cache-config family two pools must share for pages to
+        move between them (:func:`export_pages` /
+        :func:`import_pages`): everything that shapes a page's bytes.
+        ``num_pages`` / ``max_seqs`` / ``pages_per_seq`` are per-replica
+        capacity, not page layout, so they may differ."""
+        cfg = self.config
+        return (cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                cfg.page_size, str(jnp.dtype(cfg.dtype)),
+                None if cfg.kv_dtype is None
+                else str(jnp.dtype(cfg.kv_dtype)),
+                cfg.kv_block)
+
     def device_tables(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """(page_table, lengths) as device arrays — a few KB per step."""
         return (jnp.asarray(self.page_table),
@@ -502,6 +580,117 @@ def copy_pages(
     src = jnp.asarray(src, jnp.int32)
     dst = jnp.asarray(dst, jnp.int32)
     return {k: v.at[:, dst].set(v[:, src]) for k, v in pools.items()}
+
+
+def export_pages(
+    pools: Dict[str, jnp.ndarray],
+    pages,
+) -> Dict[str, np.ndarray]:
+    """Gather physical ``pages`` out of every pool buffer into HOST
+    numpy arrays — :func:`copy_pages` generalized across pools: the
+    device→host half of a cross-replica KV handoff or a page offload.
+    The staged dict has shape ``(num_layers, n_pages, heads, page_size,
+    head_dim)`` per buffer and is the wire/staging representation:
+    int8 pools stage int8 values plus their fp32 scales (a quarter of
+    the fp32 K/V bytes), bf16 stages as bf16 via ml_dtypes — no dtype
+    ever widens, so a round trip through :func:`import_pages` is
+    bit-identical."""
+    idx = jnp.asarray([int(p) for p in pages], jnp.int32)
+    # one batched device_get for the whole dict: the gathers dispatch
+    # async, then a single transfer/sync drains them together (a
+    # per-pool np.asarray would sync once per buffer)
+    return jax.device_get({k: v[:, idx] for k, v in pools.items()})
+
+
+def import_pages(
+    pools: Dict[str, jnp.ndarray],
+    staged: Dict[str, np.ndarray],
+    pages: jnp.ndarray,
+) -> Dict[str, jnp.ndarray]:
+    """Scatter a :func:`export_pages` staging dict into physical
+    ``pages`` of (usually another replica's) ``pools`` — the
+    host→device half of a handoff or a fault-in.  Pure and
+    shape-stable in everything but the page count; jit with the pools
+    donated.  The staged buffers must come from a pool of the same
+    :meth:`PagedKVCache.compat_key` family — same page layout and
+    dtypes — so the set is a bit-exact move, never a cast."""
+    idx = jnp.asarray(pages, jnp.int32)
+    return {k: v.at[:, idx].set(jnp.asarray(staged[k], v.dtype))
+            for k, v in pools.items()}
+
+
+def staged_nbytes(staged: Dict[str, np.ndarray]) -> int:
+    """Wire bytes of a staging dict — the handoff/offload telemetry
+    estimate (int8 pools: int8 payload + fp32 scales, exactly what
+    would cross a ring/DCN link)."""
+    return int(sum(np.asarray(v).nbytes for v in staged.values()))
+
+
+class HostOffloadPool:
+    """Bounded LRU host-RAM tier for evicted prefix pages.
+
+    Hangs off :attr:`PagedKVCache.evict_hook`: when the refcount GC
+    would free an index-only page, the serving layer stages its bytes
+    here instead of letting them die, keyed by the page's cumulative
+    prefix hash — so the prefix cache outlives one chip's HBM.  A
+    later admission whose prompt chains onto an offloaded hash faults
+    the page back (:meth:`take` + :meth:`PagedKVCache.adopt_prefix_page`
+    + :func:`import_pages`) bit-identically.
+
+    Entries are whole staged pages (``(layers, 1, heads, page_size,
+    head_dim)`` per pool buffer) plus the parent hash needed to relink
+    the chain.  ``max_pages`` bounds host RAM; beyond it the least
+    recently touched entry is dropped (at that point the tokens really
+    do need recompute).  ``take`` POPS — a faulted page lives on the
+    device again and the index, not this pool, owns it from then on.
+    Host-only and synchronous; stats feed the ``offload_*`` gauges."""
+
+    def __init__(self, max_pages: int):
+        if max_pages < 1:
+            raise ValueError("max_pages must be >= 1")
+        self.max_pages = int(max_pages)
+        self._entries: "collections.OrderedDict[bytes, Dict[str, Any]]" \
+            = collections.OrderedDict()
+        self.stats = {"offloaded": 0, "faulted": 0, "lru_evicted": 0,
+                      "hits": 0, "misses": 0,
+                      "bytes_in": 0, "bytes_out": 0}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, h: bytes) -> bool:
+        return h in self._entries
+
+    def put(self, h: bytes, parent: Optional[bytes],
+            staged: Dict[str, np.ndarray]) -> None:
+        """Stage one page under hash ``h`` (re-staging an existing hash
+        refreshes its LRU position and content), evicting the coldest
+        entries past ``max_pages``."""
+        if h in self._entries:
+            self._entries.pop(h)
+        self._entries[h] = {"parent": parent, "data": staged}
+        self.stats["offloaded"] += 1
+        self.stats["bytes_in"] += staged_nbytes(staged)
+        while len(self._entries) > self.max_pages:
+            self._entries.popitem(last=False)
+            self.stats["lru_evicted"] += 1
+
+    def parent(self, h: bytes) -> Optional[bytes]:
+        return self._entries[h]["parent"]
+
+    def take(self, h: bytes) -> Optional[Dict[str, Any]]:
+        """Pop hash ``h``'s entry (``{"parent", "data"}``) for a
+        fault-in, or ``None`` (and a recorded miss) when the page was
+        never offloaded or has been LRU-dropped — the caller falls back
+        to recompute."""
+        e = self._entries.pop(h, None)
+        if e is None:
+            self.stats["misses"] += 1
+            return None
+        self.stats["hits"] += 1
+        self.stats["faulted"] += 1
+        self.stats["bytes_out"] += staged_nbytes(e["data"])
+        return e
 
 
 def write_targets(

@@ -98,12 +98,18 @@ import numpy as np
 
 from apex_tpu.serving.kv_cache import (
     CacheOutOfPages,
+    HostOffloadPool,
     PagedKVCache,
     copy_pages,
+    export_pages,
+    import_pages,
+    prompt_page_hashes,
+    staged_nbytes,
 )
 from apex_tpu.telemetry.spans import phase
 
-__all__ = ["Request", "Completion", "ContinuousBatcher", "init_carry"]
+__all__ = ["Request", "Completion", "HandoffPacket",
+           "ContinuousBatcher", "init_carry"]
 
 # shared across batchers: the CoW copy compiles once per pools shape
 # (donated — without donation XLA must preserve the input pools, so a
@@ -112,6 +118,31 @@ __all__ = ["Request", "Completion", "ContinuousBatcher", "init_carry"]
 # old reference is dead.  Donation is a warning-level no-op on CPU
 # backends; the copy is still correct.)
 _copy_pages_jit = jax.jit(copy_pages, donate_argnums=0)
+
+# the handoff/fault-in scatter, same donation discipline; retraces per
+# distinct page count — handoffs are scheduling events, not the decode
+# hot loop, and the dryrun gate counts only the serving step caches
+_import_pages_jit = jax.jit(import_pages, donate_argnums=0)
+
+
+def _import_state(pools, carry, staged, pages, slot, last, written,
+                  steps_left, done, skey):
+    """The whole import-side state flip in ONE dispatch: page scatter
+    plus every per-slot carry field.  Op-by-op this is ~7 host
+    dispatches per handoff — on a host-overhead-bound fleet the fusion
+    is most of the handoff's cost."""
+    pools = import_pages(pools, staged, pages)
+    carry = {
+        "tokens": carry["tokens"].at[slot].set(last),
+        "lengths": carry["lengths"].at[slot].set(written),
+        "steps_left": carry["steps_left"].at[slot].set(steps_left),
+        "done": carry["done"].at[slot].set(done),
+        "sample_keys": carry["sample_keys"].at[slot].set(skey),
+    }
+    return pools, carry
+
+
+_import_state_jit = jax.jit(_import_state, donate_argnums=(0, 1))
 
 #: the harvest-resolve seam: both windows pull device results through
 #: this module alias, so the resilience tier can inject a hanging
@@ -150,6 +181,39 @@ class Completion:
     reason: str                 # "eos" | "budget"
     ttft_s: Optional[float] = None
     duration_s: Optional[float] = None
+
+
+@dataclasses.dataclass
+class HandoffPacket:
+    """One request's decode state in flight between replicas: the
+    committed tokens plus the staged bytes of every KV page written so
+    far (:func:`~apex_tpu.serving.kv_cache.export_pages` layout — int8
+    pools stage int8 values + fp32 scales).  Built by
+    :meth:`ContinuousBatcher.export_request` on the prefill replica,
+    consumed by :meth:`ContinuousBatcher.import_request` on the decode
+    replica; because the sampling-key schedule folds ABSOLUTE context
+    length, the continued stream is token-identical to one that never
+    moved (greedy always; sampled when the request is seeded — the
+    same precondition fleet failover replay has)."""
+
+    req: Request
+    #: tokens committed on the source before export — the destination
+    #: seeds its host stream with exactly these, so fleet progress
+    #: accounting continues without a gap
+    tokens: List[int]
+    staged: Dict[str, np.ndarray]
+    n_pages: int
+    #: KV positions written on the source: ``prompt + len(tokens) - 1``
+    #: (the newest token's K/V is written by the NEXT decode step)
+    written: int
+    wire_bytes: int
+    #: the source cache's page-layout family
+    #: (:meth:`~apex_tpu.serving.kv_cache.PagedKVCache.compat_key`) —
+    #: import refuses a mismatch rather than corrupt pages
+    compat_key: tuple
+    #: the prompt's cumulative page hashes, so the destination's prefix
+    #: index adopts the imported pages without re-hashing
+    hashes: Optional[List[bytes]] = None
 
 
 def init_carry(max_seqs: int, key: Optional[jnp.ndarray] = None
@@ -237,9 +301,15 @@ class ContinuousBatcher:
         spec_fn: Optional[Callable] = None,
         speculate_k: Optional[int] = None,
         draft_source: Optional[Any] = None,
+        offload: Optional[HostOffloadPool] = None,
     ):
         if harvest_every < 1:
             raise ValueError("harvest_every must be >= 1")
+        if offload is not None and not prefix_cache:
+            raise ValueError(
+                "offload requires prefix_cache=True (the offload tier "
+                "keys staged pages by prefix hash — without the index "
+                "nothing could ever fault them back)")
         # the device step freezes slots at ITS eos id; the host
         # truncates at THIS one.  A decode_fn that declares its freeze
         # id (GPTModel.decode_fns stamps decode.eos_id) must agree, or
@@ -371,6 +441,21 @@ class ContinuousBatcher:
         self.measure_stall = bool(measure_stall)
         self.cache = cache
         self.pools = pools
+        #: host-RAM tier for evicted prefix pages: wired into the
+        #: cache's refcount-GC seam — index-only pages the GC would
+        #: free are staged to host instead, and admissions fault them
+        #: back bit-identically (:meth:`_fault_in`)
+        self.offload = offload
+        if offload is not None:
+            cache.evict_hook = self._stage_to_offload
+        #: the disaggregation lever: a PREFILL-role replica's batcher
+        #: runs chunks and resolves first tokens but never dispatches a
+        #: decode/verify step — prompt-complete slots wait in
+        #: ``_meta`` for the fleet's handoff sweep to export them.
+        #: Scheduling-only, like the brownout levers: flipping it back
+        #: on (decode-replica-loss fallback) needs no recompile and
+        #: changes no stream's tokens.
+        self.decode_enabled = True
         self.max_prompt_len = int(max_prompt_len)
         self.harvest_every = int(harvest_every)
         self.eos_id = eos_id
@@ -506,6 +591,11 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"prompt of {plen} tokens exceeds max_prompt_len "
                     f"{self.max_prompt_len}")
+            if self.offload is not None and len(self.offload):
+                # fault offloaded prefix pages back BEFORE the match,
+                # so admit() sees them as resident and shares them —
+                # the chunks they cover are skipped, not recomputed
+                self._fault_in(req.prompt)
             try:
                 res = self.cache.admit(
                     slot, plen + req.max_new_tokens,
@@ -746,6 +836,12 @@ class ContinuousBatcher:
                           for s in list(self._first_tok)}
                 self._absorb_firsts(_device_get(firsts),
                                     time.perf_counter())
+            # a prefill-role replica stops here: chunks ran, firsts
+            # resolved, but no verify step — slots await handoff
+            if not self.decode_enabled:
+                if not did_chunk:
+                    break
+                continue
             live = [(s, m) for s, m in self._meta.items()
                     if m["finished"] is None]
             if not live:
@@ -895,8 +991,10 @@ class ContinuousBatcher:
                     chunk_s += self._prefill_step(
                         next(iter(self._prefilling)))
                     did_chunk = True
-            # ... plus one decode token for every live slot
-            if self._window_budget(base) > 0:
+            # ... plus one decode token for every live slot (a
+            # prefill-role replica never dispatches one: its
+            # prompt-complete slots wait for the handoff sweep)
+            if self.decode_enabled and self._window_budget(base) > 0:
                 with phase("decode"):
                     self.pools, self.carry = self.decode_fn(
                         self.pools, self.carry, page_table)
@@ -948,6 +1046,225 @@ class ContinuousBatcher:
         )
 
         self._retire(done_h, t_h)
+
+    # ----------------------------------------------------- offload tier
+    def _stage_to_offload(self, victims) -> None:
+        """The cache's ``evict_hook``: the refcount GC is about to free
+        a burst of index-only pages — stage their bytes to the host
+        tier in ONE device->host transfer instead of letting the
+        prefixes die (the pages themselves are still freed; their
+        CONTENT survives, keyed by hash, until LRU pressure).  Each
+        entry is copied out of the batch buffer so the pool holds one
+        page's bytes, not a view pinning the whole burst."""
+        staged = export_pages(self.pools, [p for _, _, p in victims])
+        for i, (h, parent, _) in enumerate(victims):
+            self.offload.put(h, parent, {
+                k: np.ascontiguousarray(v[:, i:i + 1])
+                for k, v in staged.items()})
+        self._event("page_offload", pages=len(victims),
+                    bytes=staged_nbytes(staged))
+
+    def _fault_in(self, prompt) -> None:
+        """Bring a prompt's offloaded prefix pages back on device:
+        walk the cumulative hash chain, and for each hash that is not
+        resident but IS staged in the host tier, adopt a fresh page
+        into the prefix index and scatter the staged bytes into it —
+        bit-identical to a page that never left.  Stops at the first
+        hash neither tier holds (the chain beyond it needs recompute).
+        The walked chain protects itself from the GC the adoption may
+        trigger, so faulting page k can never evict page j < k."""
+        cache = self.cache
+        hashes = prompt_page_hashes(prompt, cache.config.page_size)
+        chain: set = set()
+        prev = None
+        batch: List[Any] = []
+        n_bytes = misses = 0
+        t0 = time.perf_counter()
+        for h in hashes:
+            chain.add(h)
+            if h in cache._prefix:
+                prev = h
+                continue
+            if h not in self.offload:
+                misses += 1
+                self.offload.stats["misses"] += 1
+                break
+            try:
+                page = cache.adopt_prefix_page(h, prev, protect=chain)
+            except CacheOutOfPages:
+                break               # HBM truly full of live pages
+            entry = self.offload.take(h)
+            batch.append((page, entry["data"]))
+            n_bytes += staged_nbytes(entry["data"])
+            prev = h
+        pages_in = len(batch)
+        if batch:
+            # one bucketed import for the whole chain instead of a
+            # dispatch per page; padding repeats the last page (same
+            # bytes at a duplicate index — order-independent), so the
+            # jit sees at most log2(pages_per_seq) page-count shapes
+            pages = [p for p, _ in batch]
+            staged = {k: np.concatenate([d[k] for _, d in batch],
+                                        axis=1)
+                      for k in batch[0][1]}
+            bucket = min(1 << (len(pages) - 1).bit_length(),
+                         cache.config.pages_per_seq)
+            if bucket > len(pages):
+                pad = bucket - len(pages)
+                pages = pages + [pages[-1]] * pad
+                staged = {
+                    k: np.concatenate(
+                        [v, np.repeat(v[:, -1:], pad, axis=1)], axis=1)
+                    for k, v in staged.items()}
+            self.pools = _import_pages_jit(
+                self.pools, staged, jnp.asarray(pages, jnp.int32))
+        if pages_in or misses:
+            self._event(
+                "page_faultin", pages=pages_in, bytes=n_bytes,
+                tokens=pages_in * cache.config.page_size,
+                misses=misses,
+                dur_s=round(time.perf_counter() - t0, 6))
+
+    # ------------------------------------------------- handoff (fleet)
+    @property
+    def pending_prefill_chunks(self) -> int:
+        """Prefill chunks still to run for in-flight admissions — the
+        fleet router's prefill-pressure signal (host state only)."""
+        if self.prefill_chunk is None:
+            return len(self._prefilling)
+        C = self.prefill_chunk
+        return sum(max(-(-st["plen"] // C) - st["next_chunk"], 0)
+                   for st in self._prefilling.values())
+
+    def handoff_ready(self) -> List[Any]:
+        """Uids exportable RIGHT NOW: prompt fully ingested, first
+        token committed to the host stream (no pending future — the
+        packet must carry real tokens), stream unfinished."""
+        return [m["req"].uid for s, m in self._meta.items()
+                if m["finished"] is None and m["tokens"]
+                and s not in self._first_tok]
+
+    def export_request(self, uid: Any) -> Optional[HandoffPacket]:
+        """Package an in-flight request's decode state for another
+        replica: stage every KV page written so far to host and
+        release the slot (like :meth:`cancel`, no :class:`Completion`
+        is recorded — ownership MOVES).  Returns ``None`` when ``uid``
+        is not exportable (:meth:`handoff_ready`).  The caller owns
+        durability: journal the transfer BEFORE calling this — after
+        it, the pages live only in the returned packet."""
+        slot = next((s for s, m in self._meta.items()
+                     if m["req"].uid == uid), None)
+        if slot is None:
+            return None
+        m = self._meta[slot]
+        if m["finished"] is not None or not m["tokens"] \
+                or slot in self._first_tok:
+            return None
+        req = m["req"]
+        cfg = self.cache.config
+        # host length mirror == positions written on device:
+        # prompt + committed - 1 (the newest token's K/V lands on the
+        # next decode step — the destination runs that step instead)
+        written = int(self.cache.lengths[slot])
+        n_pages = cfg.tokens_to_pages(written)
+        pages = list(self.cache._slot_pages[slot][:n_pages])
+        # pad the staged block to a power-of-two page count so the
+        # import scatter compiles once per BUCKET, not once per page
+        # count — pad entries repeat the last real page, and the
+        # import repeats its destination the same way, so duplicate
+        # scatter indices carry identical bytes (order-independent)
+        bucket = min(1 << (n_pages - 1).bit_length(),
+                     cfg.pages_per_seq)
+        pages += [pages[-1]] * (bucket - n_pages)
+        staged = export_pages(self.pools, pages)
+        packet = HandoffPacket(
+            req=req, tokens=list(m["tokens"]), staged=staged,
+            n_pages=n_pages, written=written,
+            wire_bytes=staged_nbytes(staged) * n_pages // len(pages),
+            compat_key=self.cache.compat_key(),
+            hashes=(prompt_page_hashes(req.prompt, cfg.page_size)
+                    if self.prefix_cache else None))
+        del self._meta[slot]
+        self.cache.retire(slot)
+        c = self.carry
+        self.carry = {**c, "done": c["done"].at[slot].set(True)}
+        self._event("request_exported", uid=req.uid, slot=slot,
+                    pages=n_pages, bytes=packet.wire_bytes,
+                    tokens=len(packet.tokens))
+        return packet
+
+    def import_request(self, packet: HandoffPacket) -> bool:
+        """Adopt a :class:`HandoffPacket` into a free slot: allocate
+        pages for the full prompt+budget, scatter the staged bytes into
+        the leading ``n_pages`` of them, and resume decoding from the
+        packet's last token at the absolute position the source left
+        off — no recompute, and (greedy/seeded) token-identical
+        continuation by the key-schedule argument.  Returns ``False``
+        on backpressure (no free slot / no pages) — the packet stays
+        valid and the caller retries later."""
+        if packet.compat_key != self.cache.compat_key():
+            raise ValueError(
+                f"handoff across incompatible cache families: packet "
+                f"{packet.compat_key} vs pool "
+                f"{self.cache.compat_key()} — pages cannot move "
+                "between different page layouts")
+        req = packet.req
+        plen = len(req.prompt)
+        if plen > self.max_prompt_len:
+            raise ValueError(
+                f"prompt of {plen} tokens exceeds max_prompt_len "
+                f"{self.max_prompt_len}")
+        cfg = self.cache.config
+        slot = next((s for s in range(cfg.max_seqs)
+                     if s not in self._meta
+                     and s not in self._prefilling), None)
+        if slot is None:
+            return False
+        try:
+            self.cache.admit(slot, plen + req.max_new_tokens)
+        except CacheOutOfPages:
+            return False
+        pages = list(self.cache._slot_pages[slot][:packet.n_pages])
+        # mirror the export-side padding: the staged block's pad pages
+        # are copies of the last real page, landed on the last real
+        # destination page again (identical bytes, duplicate index)
+        staged_n = next(iter(packet.staged.values())).shape[1]
+        pages += [pages[-1]] * (staged_n - packet.n_pages)
+        written = packet.written
+        n_tok = len(packet.tokens)
+        last = int(packet.tokens[-1])
+        budget_left = req.max_new_tokens - n_tok
+        finished = None
+        if self.eos_id is not None and last == self.eos_id:
+            finished = "eos"
+        elif budget_left <= 0:
+            finished = "budget"
+        self.cache.lengths[slot] = written
+        skey = self._slot_key(req)
+        self._n_admits += 1
+        self.pools, self.carry = _import_state_jit(
+            self.pools, self.carry, packet.staged,
+            jnp.asarray(pages, jnp.int32), slot, last, written,
+            budget_left, finished is not None,
+            jnp.asarray(skey, jnp.uint32))
+        now = time.perf_counter()
+        self._meta[slot] = {
+            "req": req, "tokens": list(packet.tokens),
+            # TTFT already happened on the source; the fleet log owns
+            # end-to-end timing for handed-off requests
+            "t_admit": now, "t_first": now, "finished": finished,
+            "since_step": self.steps,
+        }
+        if self.prefix_cache and packet.hashes:
+            # the imported pages carry the hashes they were registered
+            # under on the source — adopt them into THIS replica's
+            # index, so followers of the same prompt share them here
+            self.cache.register_prefix(slot, req.prompt,
+                                       hashes=packet.hashes)
+        self._event("request_imported", uid=req.uid, slot=slot,
+                    pages=packet.n_pages, bytes=packet.wire_bytes,
+                    tokens=n_tok)
+        return True
 
     # ------------------------------------------------------------ cancel
     def cancel(self, uid: Any) -> Optional[List[int]]:

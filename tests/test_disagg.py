@@ -1,0 +1,498 @@
+"""Disaggregated fleet: page-level KV handoff, replica roles, and the
+host-RAM offload tier.
+
+The load-bearing claims, each pinned here:
+
+- :func:`export_pages`/:func:`import_pages` round-trip KV bytes
+  BIT-identically across pools for every page dtype family (fp32,
+  bf16, int8+scales), through arbitrary physical page ids on both
+  sides — page CONTENT is what moves, physical layout is private to
+  each pool;
+- export leaves shared/CoW refcounts intact on the source and the
+  destination's prefix index adopts the moved pages under their
+  original hashes;
+- a prefill→decode handoff is token-identical to a unified run for
+  greedy, seeded AND speculative serving (the absolute-position
+  sampling-key schedule — the same argument failover replay stands
+  on), and completions record ``handoffs``;
+- a mid-handoff staged packet is charged to the DESTINATION's load
+  score only — the source released the slot at export (the
+  double-count fix);
+- the :class:`HostOffloadPool` tier catches index-only prefix pages at
+  eviction and faults them back bit-identically under real eviction
+  pressure — a resumed session's stream matches a recompute reference
+  exactly;
+- a prefill replica dying mid-handoff loses nothing: in-flight work
+  migrates (journaled), every stream completes token-identical to an
+  unkilled reference, and full-process death recovers through
+  ``recover_journal`` the same way.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from apex_tpu.fleet import FleetPolicy, FleetRouter, Replica
+from apex_tpu.fleet.journal import RequestJournal, recover_journal
+from apex_tpu.serving.kv_cache import (
+    KVCacheConfig,
+    PagedKVCache,
+    HostOffloadPool,
+    export_pages,
+    import_pages,
+    init_pools,
+    prompt_page_hashes,
+    staged_nbytes,
+)
+from apex_tpu.serving.serve import ContinuousBatcher, Request
+from apex_tpu.serving.speculate import NGramDraftSource
+
+
+# ---------------------------------------------------------------------------
+# export/import round-trip: pure kv_cache, no model
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(num_layers=2, num_heads=2, head_dim=8, num_pages=12,
+                page_size=4, max_seqs=2, pages_per_seq=4,
+                dtype=jnp.float32)
+    base.update(kw)
+    return KVCacheConfig(**base)
+
+
+def _fill(pools, seed):
+    """Deterministic non-trivial content in every pool buffer."""
+    rng = np.random.RandomState(seed)
+    out = {}
+    for k, v in pools.items():
+        if jnp.issubdtype(v.dtype, jnp.integer):
+            data = rng.randint(-127, 128, v.shape)
+        else:
+            data = rng.randn(*v.shape)
+        out[k] = jnp.asarray(data, v.dtype)
+    return out
+
+
+class TestExportImportRoundTrip:
+    @pytest.mark.parametrize("dtype,kv_dtype", [
+        (jnp.float32, None),
+        (jnp.bfloat16, None),
+        (jnp.float32, jnp.int8),
+    ], ids=["fp32", "bf16", "int8"])
+    def test_bit_identical_across_shuffled_physical_pages(
+            self, dtype, kv_dtype):
+        cfg = _cfg(dtype=dtype, kv_dtype=kv_dtype)
+        src = _fill(init_pools(cfg), seed=1)
+        dst = init_pools(cfg)
+        # arbitrary, non-contiguous, differently-ordered page ids on
+        # each side: content moves, physical layout does not
+        src_pages = [7, 2, 9, 4]
+        dst_pages = [1, 10, 3, 6]
+        staged = export_pages(src, src_pages)
+        if kv_dtype is not None:
+            # int8 pools move quantized: int8 values + fp32 scales
+            assert set(staged) == {"k", "v", "k_scales", "v_scales"}
+            assert staged["k"].dtype == np.int8
+            assert staged["k_scales"].dtype == np.float32
+        assert staged_nbytes(staged) == sum(
+            v.nbytes for v in staged.values())
+        dst = import_pages(dst, staged, dst_pages)
+        for k in src:
+            a = np.asarray(src[k][:, src_pages])
+            b = np.asarray(dst[k][:, dst_pages])
+            assert a.tobytes() == b.tobytes(), f"pool {k!r} not bitwise"
+
+    def test_untouched_destination_pages_stay_untouched(self):
+        cfg = _cfg()
+        src = _fill(init_pools(cfg), seed=2)
+        dst = _fill(init_pools(cfg), seed=3)
+        before = {k: np.asarray(v).copy() for k, v in dst.items()}
+        dst = import_pages(dst, export_pages(src, [5]), [8])
+        others = [p for p in range(cfg.num_pages) if p != 8]
+        for k in dst:
+            assert np.asarray(dst[k][:, others]).tobytes() == \
+                before[k][:, others].tobytes()
+
+    def test_export_is_read_only_on_source(self):
+        cfg = _cfg()
+        src = _fill(init_pools(cfg), seed=4)
+        before = {k: np.asarray(v).copy() for k, v in src.items()}
+        export_pages(src, [1, 2, 3])
+        for k in src:
+            assert np.asarray(src[k]).tobytes() == before[k].tobytes()
+
+
+class TestHostOffloadPool:
+    def _staged(self, cfg, page, seed):
+        return export_pages(_fill(init_pools(cfg), seed), [page])
+
+    def test_lru_eviction_drops_coldest(self):
+        cfg = _cfg()
+        pool = HostOffloadPool(max_pages=2)
+        pool.put(b"a", None, self._staged(cfg, 1, 1))
+        pool.put(b"b", b"a", self._staged(cfg, 2, 2))
+        pool.put(b"c", b"b", self._staged(cfg, 3, 3))   # evicts "a"
+        assert b"a" not in pool and len(pool) == 2
+        assert pool.stats["lru_evicted"] == 1
+
+    def test_put_refreshes_recency(self):
+        cfg = _cfg()
+        pool = HostOffloadPool(max_pages=2)
+        pool.put(b"a", None, self._staged(cfg, 1, 1))
+        pool.put(b"b", b"a", self._staged(cfg, 2, 2))
+        pool.put(b"a", None, self._staged(cfg, 1, 1))   # re-warm "a"
+        pool.put(b"c", b"b", self._staged(cfg, 3, 3))   # evicts "b"
+        assert b"a" in pool and b"b" not in pool
+
+    def test_take_is_move_semantics(self):
+        cfg = _cfg()
+        pool = HostOffloadPool(max_pages=4)
+        staged = self._staged(cfg, 5, 5)
+        pool.put(b"h", b"p", staged)
+        entry = pool.take(b"h")
+        assert entry["parent"] == b"p"
+        assert entry["data"]["k"].tobytes() == staged["k"].tobytes()
+        assert b"h" not in pool and pool.take(b"h") is None
+        assert pool.stats["hits"] == 1 and pool.stats["misses"] == 1
+
+
+class TestAdoptPrefixPage:
+    def test_adopt_guards_and_links_parent(self):
+        cfg = _cfg()
+        cache = PagedKVCache(cfg)
+        p0 = cache.adopt_prefix_page(b"h0", None)
+        p1 = cache.adopt_prefix_page(b"h1", b"h0")
+        assert p0 != p1 and cache.prefix_index_size == 2
+        with pytest.raises(ValueError, match="already"):
+            cache.adopt_prefix_page(b"h0", None)
+        with pytest.raises(ValueError, match="parent"):
+            cache.adopt_prefix_page(b"h2", b"missing")
+
+
+# ---------------------------------------------------------------------------
+# the tiny-GPT disaggregated fleet
+# ---------------------------------------------------------------------------
+
+PAGE, NEW, MAXP = 4, 5, 24
+
+
+@pytest.fixture(scope="module")
+def disagg_setup():
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    if parallel_state.model_parallel_is_initialized():
+        parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        devices=jax.devices()[:1])
+    model = GPTModel(GPTConfig(
+        vocab_size=64, num_layers=2, hidden_size=32,
+        num_attention_heads=4, max_position_embeddings=64,
+        compute_dtype=jnp.float32, remat=False, attention_impl="xla",
+    ))
+    params = model.init(jax.random.PRNGKey(5))
+    pps = -(-(MAXP + NEW) // PAGE)
+    ccfg = KVCacheConfig(
+        num_layers=2, num_heads=4, head_dim=8,
+        num_pages=1 + 4 * pps, page_size=PAGE, max_seqs=2,
+        pages_per_seq=pps, dtype=jnp.float32)
+    fns = model.decode_fns(params, mesh, ccfg, max_prompt_len=MAXP,
+                           prefill_chunk=4)
+    sfns = model.decode_fns(params, mesh, ccfg, max_prompt_len=MAXP,
+                            prefill_chunk=4, speculate_k=3)
+    yield mesh, model, params, ccfg, fns, sfns
+    parallel_state.destroy_model_parallel()
+
+
+def _replicas(ccfg, fns, n=2, spec=False):
+    kw = (dict(spec_fn=fns.spec, speculate_k=3,
+               draft_source=NGramDraftSource(3)) if spec else {})
+    return [
+        Replica(f"r{i}", ContinuousBatcher(
+            fns.prefill, fns.decode, PagedKVCache(ccfg),
+            init_pools(ccfg), max_prompt_len=MAXP, harvest_every=2,
+            chunk_fn=fns.chunk, prefill_chunk=4, prefix_cache=True,
+            **kw))
+        for i in range(n)
+    ]
+
+
+def _reqs(seeded):
+    # repetitive prompts so the n-gram drafter gets real acceptance in
+    # the speculative variant; identity must hold regardless
+    rng = np.random.RandomState(11)
+    reqs = []
+    for i, plen in enumerate([12, 9, 11, 12]):
+        pat = rng.randint(1, 64, (4,))
+        prompt = [int(t) for t in np.tile(pat, 4)[:plen]]
+        reqs.append(Request(
+            uid=f"u{i}", prompt=prompt, max_new_tokens=NEW,
+            seed=100 + i if seeded else None))
+    return reqs
+
+
+def _streams(router):
+    return {u: c.tokens for u, c in sorted(router.completions.items())}
+
+
+class TestRoleValidation:
+    def test_unknown_role_rejected(self):
+        with pytest.raises(ValueError, match="unknown replica role"):
+            Replica("x", object(), role="verify")
+        with pytest.raises(ValueError, match="roles"):
+            FleetPolicy(roles=("prefill", "verify"))
+
+    def test_one_sided_fleets_rejected(self):
+        with pytest.raises(ValueError, match="decode"):
+            FleetPolicy(roles=("prefill", "prefill"))
+        with pytest.raises(ValueError, match="prefill"):
+            FleetPolicy(roles=("decode", "decode"))
+
+    def test_roles_length_must_match_fleet(self, disagg_setup):
+        mesh, model, params, ccfg, fns, sfns = disagg_setup
+        with pytest.raises(ValueError, match="roles"):
+            FleetRouter(_replicas(ccfg, fns, n=2),
+                        FleetPolicy(roles=("prefill", "decode",
+                                           "unified")))
+
+
+class TestHandoffIdentity:
+    @pytest.mark.parametrize("seeded", [False, True],
+                             ids=["greedy", "seeded"])
+    def test_disagg_matches_unified(self, disagg_setup, seeded):
+        mesh, model, params, ccfg, fns, sfns = disagg_setup
+
+        def run(roles):
+            router = FleetRouter(
+                _replicas(ccfg, fns),
+                FleetPolicy(roles=roles))
+            for r in _reqs(seeded):
+                assert router.submit(r)
+            router.drain()
+            return router
+
+        ref = run(None)
+        dis = run(("prefill", "decode"))
+        assert _streams(dis) == _streams(ref)
+        assert dis.stats["handoffs"] >= len(_reqs(seeded))
+        assert dis.stats["handoff_pages"] > 0
+        assert dis.stats["handoff_bytes"] > 0
+        for c in dis.completions.values():
+            assert c.handoffs >= 1
+            assert c.replays == 0          # pages moved, no recompute
+        for c in ref.completions.values():
+            assert c.handoffs == 0
+
+    def test_disagg_matches_unified_speculative(self, disagg_setup):
+        mesh, model, params, ccfg, fns, sfns = disagg_setup
+
+        def run(roles):
+            router = FleetRouter(
+                _replicas(ccfg, sfns, spec=True),
+                FleetPolicy(roles=roles))
+            for r in _reqs(seeded=False):
+                assert router.submit(r)
+            router.drain()
+            return router
+
+        ref = run(None)
+        dis = run(("prefill", "decode"))
+        assert _streams(dis) == _streams(ref)
+        assert dis.stats["handoffs"] >= 1
+
+    def test_prefill_replica_never_decodes(self, disagg_setup):
+        mesh, model, params, ccfg, fns, sfns = disagg_setup
+        router = FleetRouter(_replicas(ccfg, fns),
+                             FleetPolicy(roles=("prefill", "decode")))
+        pre, dec = router.replicas
+        assert pre.batcher.decode_enabled is False
+        assert dec.batcher.decode_enabled is True
+        for r in _reqs(seeded=False):
+            assert router.submit(r)
+        router.drain()
+        # every completion was held by the decode replica at the end,
+        # and the prefill replica ran no decode steps of its own
+        assert all(router.log.get(u).replica == "r1"
+                   for u in router.completions)
+        assert pre.batcher.steps == 0
+        assert dec.batcher.steps > 0
+
+
+class TestNoDoubleCount:
+    def test_staged_packet_charges_destination_only(self, disagg_setup):
+        mesh, model, params, ccfg, fns, sfns = disagg_setup
+        router = FleetRouter(_replicas(ccfg, fns),
+                             FleetPolicy(roles=("prefill", "decode")))
+        pre, dec = router.replicas
+        # hold the import: packets stage but cannot land
+        real_import = dec.batcher.import_request
+        dec.batcher.import_request = lambda pk: False
+        assert router.submit(_reqs(seeded=False)[0])
+        for _ in range(40):
+            router.step()
+            if router._handoffs:
+                break
+        assert len(router._handoffs) == 1
+        pk = router._handoffs[0]
+        assert pk["src"] == "r0" and pk["dst"] == "r1"
+        # the source released the slot at export; only the destination
+        # carries the request, via the in-flight-inbound load term
+        assert pre.batcher.live_slots == 0
+        assert router._inbound("r1") == 1 and router._inbound("r0") == 0
+        # the packet is worth exactly one slot of load on the
+        # destination and nothing on the source (other load terms —
+        # free pages, queues — are per-replica and unaffected)
+        with_pk = router._load(dec), router._load(pre)
+        staged, router._handoffs = router._handoffs, []
+        without = router._load(dec), router._load(pre)
+        router._handoffs = staged
+        assert with_pk[0] - without[0] == pytest.approx(
+            router.policy.w_slots)
+        assert with_pk[1] == without[1]
+        # release the import: the packet lands and the stream finishes
+        dec.batcher.import_request = real_import
+        router.drain()
+        assert router.completions["u0"].handoffs == 1
+
+    def test_staging_bounded_by_destination_slots(self, disagg_setup):
+        mesh, model, params, ccfg, fns, sfns = disagg_setup
+        router = FleetRouter(_replicas(ccfg, fns),
+                             FleetPolicy(roles=("prefill", "decode")))
+        dec = router.replicas[1]
+        dec.batcher.import_request = lambda pk: False
+        for r in _reqs(seeded=False):
+            assert router.submit(r)
+        for _ in range(60):
+            router.step()
+        max_seqs = dec.batcher.cache.config.max_seqs
+        assert 0 < len(router._handoffs) <= max_seqs
+
+
+class TestOffloadTier:
+    def test_offload_faultin_bit_identical_under_pressure(
+            self, disagg_setup):
+        mesh, model, params, ccfg, fns, sfns = disagg_setup
+        # a pool too small to hold three prompts' prefix pages: serving
+        # C must evict A's index-only pages — into the offload tier
+        tight = KVCacheConfig(
+            num_layers=2, num_heads=4, head_dim=8, num_pages=9,
+            page_size=PAGE, max_seqs=2, pages_per_seq=4,
+            dtype=jnp.float32)
+        tfns = model.decode_fns(params, mesh, tight,
+                                max_prompt_len=MAXP, prefill_chunk=4)
+
+        def batcher(off):
+            return ContinuousBatcher(
+                tfns.prefill, tfns.decode, PagedKVCache(tight),
+                init_pools(tight), max_prompt_len=MAXP,
+                harvest_every=2, chunk_fn=tfns.chunk, prefill_chunk=4,
+                prefix_cache=True, offload=off)
+
+        pA = list(range(1, 13))
+        off = HostOffloadPool(max_pages=16)
+        b = batcher(off)
+        r1 = b.run([Request(uid="a1", prompt=pA, max_new_tokens=4,
+                            seed=3)])["a1"]
+        # churn: two more 12-token prompts push A's pages out
+        b.run([Request(uid="b1", prompt=list(range(30, 42)),
+                       max_new_tokens=4, seed=4)])
+        b.run([Request(uid="c1", prompt=list(range(50, 62)),
+                       max_new_tokens=4, seed=5)])
+        assert off.stats["offloaded"] > 0
+        assert off.stats["bytes_in"] > 0
+        r2 = b.run([Request(uid="a2", prompt=pA, max_new_tokens=4,
+                            seed=3)])["a2"]
+        assert off.stats["faulted"] > 0
+        assert off.stats["bytes_out"] > 0
+        # the resumed stream must match BOTH the original serve and a
+        # cold recompute on a fresh batcher — bit-identical fault-in
+        ref = batcher(None).run(
+            [Request(uid="a2", prompt=pA, max_new_tokens=4,
+                     seed=3)])["a2"]
+        assert r2.tokens == ref.tokens == r1.tokens
+
+    def test_offload_requires_prefix_cache(self, disagg_setup):
+        mesh, model, params, ccfg, fns, sfns = disagg_setup
+        with pytest.raises(ValueError, match="prefix_cache"):
+            ContinuousBatcher(
+                fns.prefill, fns.decode, PagedKVCache(ccfg),
+                init_pools(ccfg), max_prompt_len=MAXP,
+                offload=HostOffloadPool(max_pages=4))
+
+
+class TestMidHandoffKill:
+    def test_prefill_dies_zero_loss_token_identical(
+            self, disagg_setup, tmp_path):
+        mesh, model, params, ccfg, fns, sfns = disagg_setup
+        reqs = _reqs(seeded=True)
+
+        def run(fail):
+            jr = RequestJournal(str(tmp_path / f"j_{fail}.jsonl"))
+            router = FleetRouter(
+                _replicas(ccfg, fns),
+                FleetPolicy(roles=("prefill", "decode")),
+                journal=jr)
+            if fail:
+                router.replicas[0].fail_after(2)
+            for r in reqs:
+                assert router.submit(r)
+            router.drain()
+            jr.close()
+            return router
+
+        ref = run(fail=False)
+        drill = run(fail=True)
+        assert not drill.replicas[0].alive
+        # zero loss: every uid completed, streams token-identical
+        assert sorted(drill.completions) == sorted(
+            r.uid for r in reqs)
+        assert _streams(drill) == _streams(ref)
+        # the survivor (decode role) finished everything — role
+        # fallback or migration, but never a dropped request
+        assert drill.log.pending() == 0
+
+    def test_process_death_recovers_via_journal(self, disagg_setup,
+                                                tmp_path):
+        mesh, model, params, ccfg, fns, sfns = disagg_setup
+        path = str(tmp_path / "crash.jsonl")
+        reqs = _reqs(seeded=True)
+
+        ref = FleetRouter(_replicas(ccfg, fns),
+                          FleetPolicy(roles=("prefill", "decode")))
+        for r in reqs:
+            assert ref.submit(r)
+        ref.drain()
+
+        jr = RequestJournal(path)
+        router = FleetRouter(
+            _replicas(ccfg, fns),
+            FleetPolicy(roles=("prefill", "decode")), journal=jr)
+        for r in reqs:
+            assert router.submit(r)
+        # a few steps: handoffs happen, nothing finishes draining —
+        # then the "process" dies with packets possibly in flight
+        for _ in range(6):
+            router.step()
+        jr.close()
+        del router
+
+        recovery = recover_journal(path)
+        assert len(recovery.entries) == len(reqs)
+        jr2 = RequestJournal(path)
+        restarted = FleetRouter(
+            _replicas(ccfg, fns),
+            FleetPolicy(roles=("prefill", "decode")), journal=jr2)
+        out = restarted.resume_from_journal(recovery)
+        assert out["corrupt"] == 0
+        restarted.drain()
+        jr2.close()
+        assert _streams(restarted) == _streams(ref)

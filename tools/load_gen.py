@@ -54,7 +54,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
-__all__ = ["TraceItem", "make_trace", "replay", "summarize_trace"]
+__all__ = ["TraceItem", "make_trace", "make_mixed_trace", "replay",
+           "summarize_trace"]
 
 
 @dataclasses.dataclass
@@ -144,6 +145,95 @@ def make_trace(
     return items
 
 
+def make_mixed_trace(
+    *,
+    n_requests: int,
+    seed: int,
+    vocab_size: int,
+    mean_gap: float = 1.0,
+    burstiness: float = 4.0,
+    long_frac: float = 0.5,
+    short_prompt: Tuple[int, int] = (4, 10),
+    long_prompt: Tuple[int, int] = (24, 44),
+    new_tokens: Tuple[int, int] = (3, 6),
+    interactive_frac: float = 0.8,
+    session_frac: float = 0.3,
+    idle_gap: float = 20.0,
+    resume_suffix: Tuple[int, int] = (2, 6),
+    burst_len: float = 8.0,
+) -> List[TraceItem]:
+    """The disaggregation workload: long-prompt/short-decode requests
+    whose prompt lengths are BIMODAL (``long_frac`` drawn from
+    ``long_prompt``, the rest from ``short_prompt``) with tight decode
+    budgets — prefill work dominates, which is exactly the regime where
+    prefill/decode role separation pays (a long chunked prefill on a
+    unified replica stalls every co-resident decode stream's ITL).
+
+    ``session_frac`` of requests are SESSIONS: after an ``idle_gap``
+    the same "user" returns with the original prompt plus a short
+    suffix (the follow-up turn).  By then the eviction churn of the
+    intervening traffic has typically pushed the session's prefix pages
+    out of the device index — the idle-then-resume arrival is the host
+    offload tier's exerciser (fault-in vs recompute), and without an
+    offload tier it measures the recompute cost the tier removes.
+
+    Same determinism contract as :func:`make_trace`: one seeded stream,
+    every request carries a derived seed, arrivals sorted by time."""
+    if n_requests < 1:
+        raise ValueError("n_requests must be >= 1")
+    for name, frac in (("long_frac", long_frac),
+                       ("interactive_frac", interactive_frac),
+                       ("session_frac", session_frac)):
+        if not 0.0 <= frac <= 1.0:
+            raise ValueError(f"{name} must be in [0, 1]")
+    if burstiness < 1.0:
+        raise ValueError("burstiness must be >= 1 (1 = plain Poisson)")
+    rng = np.random.RandomState(seed)
+    from apex_tpu.serving.serve import Request
+
+    items: List[TraceItem] = []
+    t, in_burst, phase_left = 0.0, True, burst_len
+    for i in range(n_requests):
+        scale = (mean_gap / burstiness if in_burst
+                 else mean_gap * burstiness)
+        t += float(rng.exponential(scale))
+        phase_left -= 1.0
+        if phase_left <= 0:
+            in_burst = not in_burst
+            phase_left = float(rng.exponential(burst_len)) + 1.0
+        lo, hi = (long_prompt if rng.rand() < long_frac
+                  else short_prompt)
+        prompt = [int(x) for x in
+                  rng.randint(1, vocab_size, (int(rng.randint(lo, hi + 1)),))]
+        slo = ("interactive" if rng.rand() < interactive_frac
+               else "batch")
+        budget = int(rng.randint(new_tokens[0], new_tokens[1] + 1))
+        items.append(TraceItem(
+            t=t,
+            request=Request(uid=f"m{i:04d}", prompt=prompt,
+                            max_new_tokens=budget,
+                            seed=int(rng.randint(1, 2**31 - 1))),
+            slo=slo, cohort=None))
+        if rng.rand() < session_frac:
+            # the follow-up turn: original prompt + a short suffix,
+            # arriving after the session went idle — its prefix is the
+            # offload tier's fault-in target
+            sfx = [int(x) for x in rng.randint(
+                1, vocab_size,
+                (int(rng.randint(resume_suffix[0],
+                                 resume_suffix[1] + 1)),))]
+            items.append(TraceItem(
+                t=t + idle_gap + float(rng.exponential(mean_gap)),
+                request=Request(
+                    uid=f"m{i:04d}s", prompt=prompt + sfx,
+                    max_new_tokens=int(rng.randint(new_tokens[0],
+                                                   new_tokens[1] + 1)),
+                    seed=int(rng.randint(1, 2**31 - 1))),
+                slo=slo, cohort=i))
+    items.sort(key=lambda it: (it.t, it.request.uid))
+    return items
+
+
 def replay(
     router,
     trace: List[TraceItem],
@@ -195,6 +285,8 @@ def replay(
             }
             if getattr(c, "hedged", False):
                 rec["hedged"] = True
+            if getattr(c, "handoffs", 0):
+                rec["handoffs"] = c.handoffs
         else:            # unreachable when drain finished
             rec = {"uid": uid, "slo": it.slo, "cohort": it.cohort,
                    "lost": True}
@@ -225,6 +317,10 @@ def summarize_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "deadline_missed": sum(1 for r in records
                                if r.get("reason") == "deadline"),
         "hedged": sum(1 for r in records if r.get("hedged")),
+        # disaggregation ledger: streams whose ownership moved by PAGE
+        # handoff (prefill -> decode) rather than replay
+        "handed_off": sum(1 for r in records
+                          if r.get("handoffs", 0) > 0),
     }
     done = [r for r in records if "reason" in r]
     out["completed"] = len(done)

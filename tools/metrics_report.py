@@ -445,6 +445,77 @@ def summarize_faults(records: List[dict]) -> Optional[Dict[str, Any]]:
     return out
 
 
+def summarize_kv_movement(records: List[dict]
+                          ) -> Optional[Dict[str, Any]]:
+    """The disaggregation/offload section: page-level KV movement.
+
+    Three event streams feed it — ``kv_handoff`` (prefill→decode
+    ownership transfers that MOVED pages instead of recomputing),
+    ``page_offload`` (index-only prefix pages staged to the host-RAM
+    tier instead of dying at eviction), and ``page_faultin`` (offloaded
+    pages adopted back into the device pool at admission).  The hit
+    rate scores the offload tier against its recompute alternative:
+    fault-in walks that found every page they asked for vs walks that
+    fell back to prefill.  None when the stream holds none of these."""
+    ev: Dict[str, List[dict]] = {}
+    for r in records:
+        if r.get("kind") == "event":
+            ev.setdefault(r.get("event"), []).append(r)
+    handoffs = ev.get("kv_handoff", [])
+    offloads = ev.get("page_offload", [])
+    faults = ev.get("page_faultin", [])
+    if not (handoffs or offloads or faults):
+        return None
+    out: Dict[str, Any] = {}
+    if handoffs:
+        durs = [float(r["dur_s"]) * 1e3 for r in handoffs
+                if isinstance(r.get("dur_s"), (int, float))]
+        routes: Dict[str, int] = {}
+        for r in handoffs:
+            key = f"{r.get('src', '?')}->{r.get('dst', '?')}"
+            routes[key] = routes.get(key, 0) + 1
+        out["handoffs"] = {
+            "count": len(handoffs),
+            "pages": sum(int(r.get("pages", 0)) for r in handoffs),
+            "wire_bytes": sum(int(r.get("bytes", 0))
+                              for r in handoffs),
+            "by_route": routes,
+        }
+        if durs:
+            out["handoffs"]["ms"] = {
+                "mean": round(sum(durs) / len(durs), 3),
+                "max": round(max(durs), 3),
+            }
+    if offloads:
+        out["offload"] = {
+            "events": len(offloads),
+            "pages": sum(int(r.get("pages", 0)) for r in offloads),
+            "wire_bytes": sum(int(r.get("bytes", 0))
+                              for r in offloads),
+        }
+    if faults:
+        durs = [float(r["dur_s"]) * 1e3 for r in faults
+                if isinstance(r.get("dur_s"), (int, float))]
+        misses = sum(1 for r in faults if int(r.get("misses", 0)) > 0)
+        out["faultin"] = {
+            "events": len(faults),
+            "pages": sum(int(r.get("pages", 0)) for r in faults),
+            "wire_bytes": sum(int(r.get("bytes", 0)) for r in faults),
+            # a walk that missed fell back to recompute for the tail;
+            # hit rate = fully-served fault-ins / all fault-in walks
+            "chain_misses": misses,
+            "hit_rate": round(1.0 - misses / len(faults), 4),
+            "prefill_tokens_saved": sum(int(r.get("tokens", 0))
+                                        for r in faults),
+        }
+        if durs:
+            out["faultin"]["ms"] = {
+                "mean": round(sum(durs) / len(durs), 3),
+                "max": round(max(durs), 3),
+            }
+    return out
+
+
 def summarize(records: List[dict]) -> Dict[str, Any]:
     """Aggregate one run's records into the report dict."""
     steps = [r for r in records if r.get("kind") == "step"]
@@ -552,7 +623,10 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
                       "cause", "retry", "consecutive", "hedged",
                       "primary", "from_level", "to_level",
                       "free_page_frac", "queue_depth", "resumed",
-                      "corrupt", "gapped"):
+                      "corrupt", "gapped",
+                      # disaggregation / offload-tier fields: page
+                      # movement routes, sizes, and fault-in misses
+                      "src", "dst", "pages", "misses"):
                 if k in r:
                     entry[k] = r[k]
             timeline.append(entry)
@@ -569,6 +643,10 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
     flt = summarize_faults(records)
     if flt:
         out["faults"] = flt
+
+    kvm = summarize_kv_movement(records)
+    if kvm:
+        out["kv_movement"] = kvm
 
     return out
 
@@ -810,6 +888,34 @@ def format_report(summary: Dict[str, Any]) -> str:
             lines.append(
                 f"  [{name}] slo attainment {a['attainment']:.1%} "
                 f"({a['deadline_missed']}/{a['n']} deadline-missed)")
+    kvm = summary.get("kv_movement")
+    if kvm:
+        lines.append("kv movement summary:")
+        ho = kvm.get("handoffs")
+        if ho:
+            routes = "  ".join(f"{k}x{v}"
+                               for k, v in sorted(ho["by_route"].items()))
+            row = (f"  handoffs: {ho['count']} ({ho['pages']} pages, "
+                   f"{ho['wire_bytes']:,} wire bytes; {routes})")
+            if "ms" in ho:
+                row += (f"  mean {ho['ms']['mean']} ms  "
+                        f"max {ho['ms']['max']} ms")
+            lines.append(row)
+        of = kvm.get("offload")
+        if of:
+            lines.append(
+                f"  offloaded: {of['pages']} pages in {of['events']} "
+                f"evictions ({of['wire_bytes']:,} bytes to host)")
+        fi = kvm.get("faultin")
+        if fi:
+            row = (f"  fault-in: {fi['pages']} pages in {fi['events']} "
+                   f"walks ({fi['wire_bytes']:,} bytes back), "
+                   f"hit rate {fi['hit_rate']:.0%}, "
+                   f"{fi['prefill_tokens_saved']} prefill tokens saved")
+            if "ms" in fi:
+                row += (f"  mean {fi['ms']['mean']} ms  "
+                        f"max {fi['ms']['max']} ms")
+            lines.append(row)
     ev = summary.get("events")
     if ev:
         lines.append("events: " + "  ".join(
